@@ -1,0 +1,14 @@
+"""gpt_2_distributed_tpu — a TPU-native (JAX/XLA/pjit/Pallas) GPT-2 pretraining framework.
+
+Capability parity target: dpickem/gpt_2_distributed (see SURVEY.md), re-designed
+TPU-first: one pure-functional model + one jitted train step per sharding
+configuration, with parallelism expressed entirely as `jax.sharding` annotations
+over a named device mesh (GSPMD inserts the ICI/DCN collectives that the
+reference obtains from NCCL via torch DDP/FSDP wrappers).
+"""
+
+from gpt_2_distributed_tpu.config import GPT2Config, MODEL_PRESETS
+
+__version__ = "0.1.0"
+
+__all__ = ["GPT2Config", "MODEL_PRESETS", "__version__"]

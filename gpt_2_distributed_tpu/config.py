@@ -1,0 +1,85 @@
+"""Model configuration.
+
+Mirrors the reference's frozen ``GPT2Config`` dataclass surface
+(``/root/reference/model.py:26-57``): same field meanings and same defaults
+(GPT-2 124M: vocab 50257, 1024 positions, 768 width, 12 layers, 12 heads,
+0.1 dropouts, LN eps 1e-5, init std 0.02). Extends it with the 345M/774M/1.5B
+presets that BASELINE.json's configs require but the reference hard-codes out
+(``/root/reference/train_gpt2_distributed.py:42-44`` only ever builds 124M).
+
+TPU-first additions: ``remat`` (activation checkpointing for the 774M/1.5B
+configs) and ``scan_layers`` (stack per-layer params on a leading axis and run
+the block stack as one ``lax.scan`` — constant-size HLO regardless of depth,
+which keeps XLA compile time flat from 12 to 48 layers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    """Architecture hyperparameters for a GPT-2 style decoder-only LM.
+
+    Defaults are GPT-2 124M, matching the reference's defaults field-for-field
+    (``/root/reference/model.py:26-57``).
+    """
+
+    vocab_size: int = 50257        # GPT-2 BPE vocab (50,000 merges + 256 bytes + EOT)
+    n_positions: int = 1024        # maximum sequence length (learned positional table)
+    n_embd: int = 768              # residual stream width C
+    n_layer: int = 12              # transformer blocks
+    n_head: int = 12               # attention heads; head_dim = n_embd // n_head
+    embd_dropout: float = 0.1      # dropout on wte+wpe sum
+    attn_dropout: float = 0.1      # dropout on attention probabilities
+    resid_dropout: float = 0.1     # dropout on attn out-proj, MLP activation and MLP out-proj
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02  # N(0, 0.02) for Linear/Embedding weights
+    # --- TPU-build extensions (not in the reference) ---
+    remat: bool = False            # activation checkpointing of each block (lax.scan body)
+    scan_layers: bool = True       # stacked-layer params + lax.scan over blocks
+
+    def __post_init__(self) -> None:
+        if self.n_embd % self.n_head != 0:
+            raise ValueError(
+                f"n_embd={self.n_embd} must be divisible by n_head={self.n_head}"
+            )
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def max_seq_len(self) -> int:
+        """Alias matching the reference's ``GPT2Backbone.max_seq_len`` property
+        (``/root/reference/model.py:271-273``)."""
+        return self.n_positions
+
+    def replace(self, **kwargs) -> "GPT2Config":
+        return dataclasses.replace(self, **kwargs)
+
+    def num_params(self, include_embeddings: bool = True) -> int:
+        """Exact parameter count (lm_head is tied to wte, so it adds nothing)."""
+        c, l, v, p = self.n_embd, self.n_layer, self.vocab_size, self.n_positions
+        per_block = (
+            2 * (2 * c)                 # ln1, ln2 (scale + bias)
+            + c * 3 * c + 3 * c         # fused qkv projection
+            + c * c + c                 # attention out-projection
+            + c * 4 * c + 4 * c         # MLP fc1
+            + 4 * c * c + c             # MLP fc2
+        )
+        n = l * per_block + 2 * c       # blocks + final LN
+        if include_embeddings:
+            n += v * c + p * c          # wte + wpe (lm_head tied)
+        return n
+
+
+# BASELINE.json configs 1-5 require these four sizes; the standard GPT-2 family.
+MODEL_PRESETS: dict[str, GPT2Config] = {
+    "124M": GPT2Config(n_layer=12, n_embd=768, n_head=12),
+    "345M": GPT2Config(n_layer=24, n_embd=1024, n_head=16),
+    "774M": GPT2Config(n_layer=36, n_embd=1280, n_head=20),
+    "1.5B": GPT2Config(n_layer=48, n_embd=1600, n_head=25),
+}

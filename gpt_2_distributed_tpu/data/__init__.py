@@ -1,0 +1,21 @@
+from gpt_2_distributed_tpu.data.dataloader import (
+    DEFAULT_BATCH_SIZE,
+    DEFAULT_CONTEXT_LENGTH,
+    DEFAULT_NUM_WORKERS,
+    DEFAULT_PREFETCH_FACTOR,
+    TokenShardDataset,
+    create_dataloader,
+    get_shard_paths,
+)
+from gpt_2_distributed_tpu.data.synthetic import write_synthetic_shards
+
+__all__ = [
+    "DEFAULT_BATCH_SIZE",
+    "DEFAULT_CONTEXT_LENGTH",
+    "DEFAULT_NUM_WORKERS",
+    "DEFAULT_PREFETCH_FACTOR",
+    "TokenShardDataset",
+    "create_dataloader",
+    "get_shard_paths",
+    "write_synthetic_shards",
+]

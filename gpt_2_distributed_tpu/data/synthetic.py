@@ -1,0 +1,73 @@
+"""Synthetic shard generation for tests and benchmarks.
+
+Produces ``.bin`` files byte-identical in format to the reference's FineWeb
+tokenization pipeline output (``/root/reference/data/fineweb_10BT_hugging_face
+.ipynb`` cells 8, 13): flat little-endian uint16 token streams, filename
+``{dataset}_{split}_{index:06d}.bin``, shard index 0 reserved for "val".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+GPT2_EOT = 50256  # tiktoken gpt2 <|endoftext|>
+
+
+def write_token_shard_uint16(path: str, tokens: np.ndarray) -> None:
+    """Write a flat little-endian uint16 token stream, the reference's
+    ``write_token_shard_uint16_to_bin`` format (notebook cell 8)."""
+    tokens = np.asarray(tokens)
+    if tokens.min(initial=0) < 0 or tokens.max(initial=0) > np.iinfo(np.uint16).max:
+        raise ValueError("token ids out of uint16 range")
+    tokens.astype("<u2").tofile(path)
+
+
+def write_synthetic_shards(
+    data_dir: str,
+    num_shards: int = 3,
+    tokens_per_shard: int = 32_768,
+    vocab_size: int = 50257,
+    dataset_name: str = "synthetic",
+    seed: int = 0,
+) -> list[str]:
+    """Write ``num_shards`` random-token shards; shard 0 is the "val" split and
+    the rest are "train", matching the reference's split convention (notebook
+    cell 13). Returns the paths written. Also writes a ``metadata.json`` index
+    like the notebook's cell 15 (informational; the trainer globs by filename)."""
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i in range(num_shards):
+        split = "val" if i == 0 else "train"
+        # Learnable structure, not uniform noise: mostly ascending runs
+        # (next = cur + 1 mod vocab) from random starts, so a model can push
+        # loss well below ln(vocab) and integration tests can assert descent.
+        starts = rng.integers(0, vocab_size, size=tokens_per_shard // 64 + 1)
+        ramp = np.arange(tokens_per_shard)
+        tokens = (
+            (starts.repeat(64)[:tokens_per_shard] + ramp % 64) % vocab_size
+        ).astype(np.uint16)
+        # EOT markers sprinkled in so decoded data looks document-like. For
+        # reduced test vocabs the EOT id must stay in range — an out-of-vocab
+        # token would NaN the embedding gather.
+        eot = min(GPT2_EOT, vocab_size - 1)
+        tokens[:: max(1, tokens_per_shard // 17)] = eot
+        path = os.path.join(data_dir, f"{dataset_name}_{split}_{i:06d}.bin")
+        write_token_shard_uint16(path, tokens)
+        paths.append(path)
+    with open(os.path.join(data_dir, "metadata.json"), "w") as f:
+        json.dump(
+            {
+                "dataset": dataset_name,
+                "num_shards": num_shards,
+                "tokens_per_shard": tokens_per_shard,
+                "dtype": "uint16",
+                "shards": [os.path.basename(p) for p in paths],
+            },
+            f,
+            indent=2,
+        )
+    return paths

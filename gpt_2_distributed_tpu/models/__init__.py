@@ -1,0 +1,3 @@
+from gpt_2_distributed_tpu.models import gpt2
+
+__all__ = ["gpt2"]

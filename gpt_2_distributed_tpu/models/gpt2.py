@@ -1,0 +1,228 @@
+"""GPT-2 as pure functions over a parameter pytree.
+
+Capability parity with the reference's ``model.py`` (pre-LN GPT-2, learned
+positional embeddings, fused qkv, exact-OpenAI tanh GELU, tied lm_head,
+N(0, 0.02) seeded init, flat cross-entropy with ignore_index=-100), expressed
+TPU-first:
+
+* **Params are a pytree**, not module state — the same ``forward`` is jitted
+  under any `jax.sharding` configuration; DDP vs FSDP is purely a change of
+  `NamedSharding` on this tree, not a different wrapper class.
+* **Per-layer parameters are stacked on a leading [n_layer, ...] axis** and the
+  block stack runs as one ``lax.scan`` — HLO size is constant in depth, so the
+  1.5B (48-layer) config compiles as fast as 124M, and `jax.checkpoint` on the
+  scan body gives FSDP-style per-block rematerialization for free.
+* **Mixed precision** follows torch autocast semantics the reference trains
+  under (``/root/reference/train_gpt2_distributed.py:404``): params stay fp32;
+  matmuls run in ``compute_dtype`` (bf16); LayerNorm, softmax and the
+  cross-entropy run in fp32.
+
+Reference compute graph being reproduced (``/root/reference/model.py``):
+  wte[idx] + wpe[:T] -> embd dropout                    (:295-304)
+  12 x [ x += attn(ln1(x)); x += mlp(ln2(x)) ]          (:215-218, 307-308)
+      attn: fused qkv (:95,116), split heads (:124-129), qk^T/sqrt(d) (:137),
+            mask -1e4 (:144), softmax+drop (:145-146), @v, out proj+drop (:151-158)
+      mlp: fc1(C->4C) -> tanh-GELU -> drop -> fc2(4C->C) -> drop (:186-192;
+            note the post-activation dropout at :188 — preserved here)
+  ln_f (:311) -> logits = lm_head(x), lm_head tied to wte (:326-333,351)
+  loss = flat CE(logits, labels, ignore_index=-100) (:353-359) — labels are
+  already next-tokens (the dataloader shifts, dataloader.py:131-132), so no
+  logit/label shift here either.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gpt_2_distributed_tpu.config import GPT2Config
+from gpt_2_distributed_tpu.ops.activations import gelu_tanh
+from gpt_2_distributed_tpu.ops.attention import causal_attention
+from gpt_2_distributed_tpu.ops.layers import dropout, layer_norm
+
+Params = dict[str, Any]
+
+IGNORE_INDEX = -100  # reference CE ignore_index, /root/reference/model.py:357-359
+INIT_SEED = 42  # reference's dedicated init generator seed, /root/reference/model.py:250-252
+
+
+def init_params(
+    config: GPT2Config, seed: int = INIT_SEED, dtype: jnp.dtype = jnp.float32
+) -> Params:
+    """Seeded init matching the reference's distribution exactly
+    (``/root/reference/model.py:250-268``): N(0, initializer_range) for every
+    Linear and Embedding weight, zero biases, LayerNorm at (1, 0). The lm_head
+    is tied to ``wte`` (``model.py:326-333``) so it has no parameters here.
+
+    Per-layer params are stacked: each leaf under ``params["block"]`` has a
+    leading ``n_layer`` axis.
+    """
+    c, l, v, p = config.n_embd, config.n_layer, config.vocab_size, config.n_positions
+    std = config.initializer_range
+    key = jax.random.PRNGKey(seed)
+    k_wte, k_wpe, k_attn, k_attn_proj, k_fc1, k_fc2 = jax.random.split(key, 6)
+
+    def normal(k, shape):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * std).astype(dtype)
+
+    zeros = lambda shape: jnp.zeros(shape, dtype=dtype)
+    ones = lambda shape: jnp.ones(shape, dtype=dtype)
+
+    return {
+        "wte": normal(k_wte, (v, c)),
+        "wpe": normal(k_wpe, (p, c)),
+        "block": {
+            "ln1_scale": ones((l, c)),
+            "ln1_bias": zeros((l, c)),
+            "attn_qkv_w": normal(k_attn, (l, c, 3 * c)),
+            "attn_qkv_b": zeros((l, 3 * c)),
+            "attn_proj_w": normal(k_attn_proj, (l, c, c)),
+            "attn_proj_b": zeros((l, c)),
+            "ln2_scale": ones((l, c)),
+            "ln2_bias": zeros((l, c)),
+            "mlp_fc_w": normal(k_fc1, (l, c, 4 * c)),
+            "mlp_fc_b": zeros((l, 4 * c)),
+            "mlp_proj_w": normal(k_fc2, (l, 4 * c, c)),
+            "mlp_proj_b": zeros((l, c)),
+        },
+        "ln_f_scale": ones((c,)),
+        "ln_f_bias": zeros((c,)),
+    }
+
+
+def count_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def _block(
+    config: GPT2Config,
+    x: jnp.ndarray,  # [B, T, C] in compute dtype
+    bp: dict[str, jnp.ndarray],  # one layer's params (no leading L axis)
+    rng: jax.Array | None,
+    deterministic: bool,
+) -> jnp.ndarray:
+    """One pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+    b, t, c = x.shape
+    h, d = config.n_head, config.head_dim
+    cdt = x.dtype
+    if rng is not None:
+        r_attn, r_aresid, r_mact, r_mresid = jax.random.split(rng, 4)
+    else:
+        r_attn = r_aresid = r_mact = r_mresid = None
+
+    # Attention sublayer
+    y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
+    qkv = y @ bp["attn_qkv_w"].astype(cdt) + bp["attn_qkv_b"].astype(cdt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    # [B, T, C] -> [B, H, T, D]
+    q = q.reshape(b, t, h, d).transpose(0, 2, 1, 3)
+    k = k.reshape(b, t, h, d).transpose(0, 2, 1, 3)
+    v = v.reshape(b, t, h, d).transpose(0, 2, 1, 3)
+    o = causal_attention(
+        q, k, v,
+        dropout_rate=config.attn_dropout, rng=r_attn, deterministic=deterministic,
+    )
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, c)
+    o = o @ bp["attn_proj_w"].astype(cdt) + bp["attn_proj_b"].astype(cdt)
+    o = dropout(o, config.resid_dropout, r_aresid, deterministic)
+    x = x + o
+
+    # MLP sublayer (dropout after the activation AND after the projection,
+    # matching the reference's extra site at model.py:188)
+    y = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"], config.layer_norm_eps)
+    y = y @ bp["mlp_fc_w"].astype(cdt) + bp["mlp_fc_b"].astype(cdt)
+    y = gelu_tanh(y)
+    y = dropout(y, config.resid_dropout, r_mact, deterministic)
+    y = y @ bp["mlp_proj_w"].astype(cdt) + bp["mlp_proj_b"].astype(cdt)
+    y = dropout(y, config.resid_dropout, r_mresid, deterministic)
+    return x + y
+
+
+def forward(
+    params: Params,
+    config: GPT2Config,
+    idx: jnp.ndarray,  # [B, T] int token ids
+    labels: jnp.ndarray | None = None,  # [B, T] next-token ids, -100 = ignore
+    *,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[jnp.ndarray, jnp.ndarray | None]:
+    """Forward pass. Returns ``(logits [B,T,V] fp32, loss scalar fp32 | None)``.
+
+    Sequence-length guard matches the reference's hard error beyond
+    n_positions (``/root/reference/model.py:291-292``) — here it is a trace-time
+    (static-shape) check, which is the XLA-native place for it.
+    """
+    b, t = idx.shape
+    if t > config.n_positions:
+        raise ValueError(
+            f"sequence length {t} exceeds n_positions {config.n_positions}"
+        )
+    if not deterministic and rng is None:
+        raise ValueError("training-mode forward (deterministic=False) needs rng")
+
+    if rng is not None:
+        r_embd, r_blocks = jax.random.split(rng)
+    else:
+        r_embd = r_blocks = None
+
+    # Clip-mode gather: out-of-range token ids clamp (TPU hardware gather
+    # semantics) instead of JAX's default NaN-fill — a stray corrupt token
+    # degrades to a wrong embedding rather than silently NaN-ing the step.
+    tok_embd = params["wte"].astype(compute_dtype).at[idx].get(mode="clip")
+    x = tok_embd + params["wpe"].astype(compute_dtype)[:t]
+    x = dropout(x, config.embd_dropout, r_embd, deterministic)
+
+    block_params = params["block"]
+    if config.scan_layers:
+        layer_rngs = (
+            jax.random.split(r_blocks, config.n_layer)
+            if r_blocks is not None
+            else jnp.zeros((config.n_layer, 2), dtype=jnp.uint32)
+        )
+
+        def body(carry, layer):
+            bp, lr = layer
+            out = _block(config, carry, bp, lr if r_blocks is not None else None,
+                         deterministic)
+            return out, None
+
+        if config.remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, (block_params, layer_rngs))
+    else:
+        for i in range(config.n_layer):
+            bp = jax.tree_util.tree_map(lambda a: a[i], block_params)
+            lr = jax.random.fold_in(r_blocks, i) if r_blocks is not None else None
+            blk = jax.checkpoint(_block, static_argnums=(0, 4)) if config.remat else _block
+            x = blk(config, x, bp, lr, deterministic)
+
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
+    # Tied lm_head: logits = x @ wte^T, fp32 accumulation out of the bf16 matmul.
+    logits = jnp.einsum(
+        "btc,vc->btv", x, params["wte"].astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+    loss = None
+    if labels is not None:
+        loss = cross_entropy(logits, labels)
+    return logits, loss
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Flat token-mean cross-entropy with ignore_index=-100, fp32 — the
+    reference's loss exactly (``/root/reference/model.py:353-359``)."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != IGNORE_INDEX
+    safe_labels = jnp.where(valid, labels, 0)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(
+        logprobs, safe_labels[..., None], axis=-1, mode="clip"
+    )[..., 0]
+    ll = jnp.where(valid, ll, 0.0)
+    count = jnp.maximum(valid.sum(), 1)
+    return -(ll.sum() / count)

@@ -1,0 +1,5 @@
+from gpt_2_distributed_tpu.ops.activations import gelu_tanh
+from gpt_2_distributed_tpu.ops.attention import causal_attention
+from gpt_2_distributed_tpu.ops.layers import dropout, layer_norm
+
+__all__ = ["gelu_tanh", "causal_attention", "dropout", "layer_norm"]

@@ -1,0 +1,18 @@
+"""Activation functions."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact-OpenAI tanh-approximation GELU:
+    ``0.5 * x * (1 + tanh(sqrt(2/pi) * (x + 0.044715 * x^3)))``.
+
+    The reference implements this form explicitly as ``NewGELU``
+    (``/root/reference/model.py:63-77``); it is also ``jax.nn.gelu`` with
+    ``approximate=True``, but we spell it out so the parity contract is
+    visible and independent of jax.nn's implementation choices.
+    """
+    c = jnp.sqrt(jnp.asarray(2.0 / jnp.pi, dtype=x.dtype))
+    return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * jnp.power(x, 3.0))))

@@ -1,0 +1,55 @@
+"""Causal multi-head self-attention math.
+
+Behavioral parity with the reference's ``CausalMultiHeadSelfAttention``
+(``/root/reference/model.py:80-159``): scaled dot-product over split heads,
+causal positions masked to **-1e4** before the softmax (not -inf — the
+reference masked-fills with -1e4 and after softmax the difference is below
+bf16 resolution, but we keep the exact constant for loss-curve parity),
+dropout on the attention probabilities.
+
+TPU-first shape: no precomputed ``n_positions x n_positions`` mask buffer (the
+reference materializes one as a module buffer, ``model.py:105-108``); the mask
+is an iota comparison fused by XLA into the softmax, costing zero HBM. Scores
+are accumulated in fp32 via ``preferred_element_type`` so the bf16 MXU matmul
+keeps fp32 softmax inputs — the same numerics torch autocast produces (bf16
+matmul, fp32 softmax).
+
+This dense O(T^2) formulation is the parity baseline; `flash` (a Pallas
+fused kernel) is selected by the caller when profiling demands it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MASK_VALUE = -1e4  # reference masks scores to -1e4, /root/reference/model.py:144
+
+
+def causal_attention(
+    q: jnp.ndarray,  # [B, H, T, D]
+    k: jnp.ndarray,  # [B, H, T, D]
+    v: jnp.ndarray,  # [B, H, T, D]
+    *,
+    dropout_rate: float = 0.0,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+) -> jnp.ndarray:
+    """Dense causal attention. Returns [B, H, T, D] in q's dtype."""
+    _, _, t, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    # bf16 inputs, fp32 accumulation: the MXU computes bf16 x bf16 -> fp32.
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    causal = kpos <= qpos
+    scores = jnp.where(causal, scores, jnp.asarray(MASK_VALUE, dtype=scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1)
+    if not deterministic and dropout_rate > 0.0:
+        if rng is None:
+            raise ValueError("attention dropout requires an rng key")
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), jnp.zeros_like(probs))
+    probs = probs.astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

@@ -1,0 +1,38 @@
+"""Small shared layers: layer norm (fp32 internals) and inverted dropout."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm(
+    x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-5
+) -> jnp.ndarray:
+    """LayerNorm over the last axis, computed in fp32 regardless of the
+    compute dtype (matching torch autocast, which runs LayerNorm in fp32 while
+    matmuls run in bf16 — the reference trains under ``autocast(bf16)``,
+    ``/root/reference/train_gpt2_distributed.py:404``). Returns x's dtype."""
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(orig_dtype)
+
+
+def dropout(
+    x: jnp.ndarray,
+    rate: float,
+    rng: jax.Array | None,
+    deterministic: bool,
+) -> jnp.ndarray:
+    """Inverted dropout. No-op when deterministic or rate == 0."""
+    if deterministic or rate == 0.0:
+        return x
+    if rng is None:
+        raise ValueError("dropout requires an rng key when not deterministic")
+    keep_prob = 1.0 - rate
+    keep = jax.random.bernoulli(rng, keep_prob, x.shape)
+    return jnp.where(keep, x / keep_prob, jnp.zeros_like(x))

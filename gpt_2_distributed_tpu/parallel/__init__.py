@@ -1,0 +1,29 @@
+from gpt_2_distributed_tpu.parallel.mesh import (
+    MeshSpec,
+    create_mesh,
+    init_distributed,
+    is_primary,
+)
+from gpt_2_distributed_tpu.parallel.sharding import (
+    batch_pspec,
+    param_pspecs,
+    shard_batch,
+    shard_params_and_opt_state,
+)
+from gpt_2_distributed_tpu.parallel.train_step import (
+    make_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "MeshSpec",
+    "create_mesh",
+    "init_distributed",
+    "is_primary",
+    "batch_pspec",
+    "param_pspecs",
+    "shard_batch",
+    "shard_params_and_opt_state",
+    "make_optimizer",
+    "make_train_step",
+]

@@ -1,0 +1,126 @@
+"""Device mesh construction and multi-host bootstrap.
+
+This is the TPU-native replacement for the reference's L1 layer
+(``init_distributed`` + torchrun env rendezvous + NCCL process group,
+``/root/reference/train_gpt2_distributed.py:50-64`` and ``scripts/*.sh``):
+
+* ``init_distributed()`` wraps ``jax.distributed.initialize`` with the same
+  env-var contract torchrun uses (MASTER_ADDR/MASTER_PORT -> coordinator,
+  WORLD_SIZE -> num_processes, RANK -> process_id), so the reference's
+  main/worker launch-script pair translates 1:1 to TPU-VM hosts.
+* ``create_mesh()`` builds one 2-D ``jax.sharding.Mesh`` with axes
+  ``('data', 'fsdp')``. Every execution mode of the reference is a *shape* of
+  this mesh, not a different code path:
+    - ``local``:  no mesh (single device)
+    - ``dp``/``ddp``:    ``(n_devices, 1)`` — batch sharded over 'data',
+      params replicated; GSPMD emits the gradient psum that DDP gets from
+      NCCL backward hooks
+    - ``fsdp``:   ``(1, n_devices)`` — batch AND params sharded over 'fsdp';
+      GSPMD emits the all-gather-compute / reduce-scatter schedule that torch
+      FSDP FULL_SHARD orchestrates by hand
+    - hybrid (HSDP; beyond the reference): ``(k, n/k)`` — params sharded
+      within 'fsdp' groups, gradients additionally reduced across 'data',
+      laying shardings so param collectives ride ICI and only gradient
+      reduction crosses DCN slices.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+
+TRAINING_MODES = ("local", "dp", "ddp", "fsdp")
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bootstrap: ``jax.distributed.initialize`` with torchrun-style
+    env fallbacks, mirroring the reference's launcher contract
+    (``/root/reference/scripts/run_training_distributed_fsdp_main.sh:15-20``):
+    MASTER_ADDR:MASTER_PORT, WORLD_SIZE, RANK. No-op for single-process runs
+    when no coordinator can be determined.
+    """
+    if coordinator_address is None:
+        addr = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("MASTER_ADDR")
+        port = os.environ.get("MASTER_PORT", "12355")
+        coordinator_address = f"{addr}:{port}" if addr and ":" not in addr else addr
+    if num_processes is None:
+        ws = os.environ.get("NUM_PROCESSES") or os.environ.get("WORLD_SIZE")
+        num_processes = int(ws) if ws else None
+    if process_id is None:
+        r = os.environ.get("PROCESS_ID") or os.environ.get("RANK")
+        process_id = int(r) if r else None
+    if coordinator_address is None and num_processes is None:
+        return  # single-process: nothing to initialize
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_primary() -> bool:
+    """Rank-0 check, parity with the reference's ``is_primary``
+    (``/root/reference/train_gpt2_distributed.py:62-64``)."""
+    return jax.process_index() == 0
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Mesh shape: data-parallel degree x param-shard (fsdp) degree."""
+
+    data: int = 1
+    fsdp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.fsdp
+
+    @classmethod
+    def parse(cls, text: str) -> "MeshSpec":
+        """Parse ``"data=2,fsdp=4"`` (either key optional)."""
+        kwargs = {}
+        for part in text.split(","):
+            if not part.strip():
+                continue
+            key, _, val = part.partition("=")
+            kwargs[key.strip()] = int(val)
+        return cls(**kwargs)
+
+    @classmethod
+    def for_mode(cls, mode: str, n_devices: int | None = None) -> "MeshSpec":
+        if n_devices is None:
+            n_devices = jax.device_count()
+        if mode == "local":
+            return cls(1, 1)
+        if mode in ("dp", "ddp"):
+            return cls(n_devices, 1)
+        if mode == "fsdp":
+            return cls(1, n_devices)
+        raise ValueError(f"unknown training_mode {mode!r}; expected one of {TRAINING_MODES}")
+
+
+def create_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
+    """A 2-D ('data', 'fsdp') mesh over the first data*fsdp devices.
+
+    Device order follows ``jax.devices()``, which JAX arranges so that
+    adjacent devices are ICI neighbors — the trailing ('fsdp') axis therefore
+    gets the fastest links, which is where the per-block all-gathers live.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = spec.n_devices
+    if n > len(devices):
+        raise ValueError(f"mesh {spec} needs {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(spec.data, spec.fsdp)
+    return Mesh(grid, (DATA_AXIS, FSDP_AXIS))

@@ -1,0 +1,108 @@
+"""Sharding rules: the single place parallelism lives.
+
+The reference implements DDP and FSDP as two different wrapper classes with
+hand-orchestrated NCCL collectives (``/root/reference/train_gpt2_distributed
+.py:129-165``). Here both are *data placements*: a PartitionSpec per parameter
+leaf plus a batch PartitionSpec, and GSPMD derives the collective schedule —
+gradient psum over 'data' (DDP parity), per-block all-gather/reduce-scatter
+over 'fsdp' (FSDP FULL_SHARD parity, cf. the lifecycle in SURVEY.md §3.3).
+
+Param rule: shard the largest weight dimension that divides the 'fsdp' axis
+size, preferring trailing dims (contiguous lanes); never shard the stacked
+layer axis (axis 0 of block leaves) — the lax.scan over layers slices that
+axis every iteration, and sharding it would turn each slice into a collective.
+Leaves with no divisible dim stay replicated (e.g. nothing forces vocab 50257
+to pad).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gpt_2_distributed_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS
+
+
+def _leaf_pspec(path: tuple, leaf: Any, fsdp_size: int) -> P:
+    """PartitionSpec for one parameter leaf under the 'fsdp' axis."""
+    if fsdp_size <= 1:
+        return P()  # replicated (pure DP / local)
+    shape = np.shape(leaf)
+    if len(shape) == 0:
+        return P()
+    # Stacked per-layer leaves live under the "block" subtree; their axis 0 is
+    # the layer axis and must stay unsharded (see module docstring).
+    is_block = any(getattr(k, "key", None) == "block" for k in path)
+    candidate_dims = range(len(shape) - 1, 0 if is_block else -1, -1)
+    best_dim = None
+    for d in candidate_dims:
+        if shape[d] % fsdp_size == 0:
+            if best_dim is None or shape[d] > shape[best_dim]:
+                best_dim = d
+    if best_dim is None:
+        return P()
+    spec: list = [None] * len(shape)
+    spec[best_dim] = FSDP_AXIS
+    return P(*spec)
+
+
+def param_pspecs(params: Any, mesh: Mesh) -> Any:
+    """PartitionSpec pytree for params (and, by structure, any like-shaped
+    tree such as optimizer moments)."""
+    fsdp_size = mesh.shape[FSDP_AXIS]
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _leaf_pspec(path, leaf, fsdp_size), params
+    )
+
+
+def batch_pspec(leading_accum_axis: bool = True) -> P:
+    """Batch sharding: the batch dim is split over BOTH mesh axes — under pure
+    FSDP the mesh is (1, N) so this reproduces torch FULL_SHARD's
+    data-parallelism across all ranks; under pure DP it is plain batch
+    sharding. The grad-accum axis (scanned) and sequence axis stay unsharded.
+    """
+    if leading_accum_axis:
+        return P(None, (DATA_AXIS, FSDP_AXIS), None)
+    return P((DATA_AXIS, FSDP_AXIS), None)
+
+
+def shard_params_and_opt_state(
+    params: Any, optimizer, mesh: Mesh
+) -> tuple[Any, Any, Any]:
+    """Place params on the mesh per the param rule and build the optimizer
+    state already-sharded: ``optimizer.init`` runs under jit with sharded
+    params as input, so XLA lays every moment buffer out exactly like its
+    parameter (ZeRO-1/2 for free — optimizer state is sharded whenever params
+    are).
+
+    Returns ``(sharded_params, sharded_opt_state, param_shardings)``.
+    """
+    pspecs = param_pspecs(params, mesh)
+    shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    params = jax.device_put(params, shardings)
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state, shardings
+
+
+def shard_batch(batch: Any, mesh: Mesh, leading_accum_axis: bool = True) -> Any:
+    """Place a host numpy batch (x, y) onto the mesh with the batch sharding.
+
+    Single-host: a plain sharded ``device_put``. Multi-host: each process owns
+    a disjoint slice of the global batch (the dataloader's (process, worker)
+    striding guarantees disjointness), and
+    ``jax.make_array_from_process_local_data`` assembles the logical global
+    array from per-host shards — the TPU-native analogue of the reference's
+    per-rank DataLoader + NCCL implicit global batch.
+    """
+    sharding = NamedSharding(mesh, batch_pspec(leading_accum_axis))
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+    )

@@ -1,0 +1,60 @@
+"""Test environment: force an 8-device virtual CPU platform BEFORE jax import.
+
+This is the multi-device-without-a-cluster strategy from SURVEY.md §4: DP and
+FSDP sharding tests run against 8 virtual CPU devices, so the full parallelism
+surface is exercised in CI with no TPU attached.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+import numpy as np
+import pytest
+
+# The axon boot hook force-registers the TPU backend regardless of the
+# JAX_PLATFORMS env var; the config update below is what actually pins tests
+# to the virtual 8-device CPU platform.
+jax.config.update("jax_platforms", "cpu")
+# Matmuls default to a reduced-precision fastmath mode (bf16-class, ~1e-1 abs
+# error on unit-scale fp32 matmuls); golden-parity tests need real fp32.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def shard_dir(tmp_path_factory):
+    """Synthetic uint16 .bin shards shared across tests."""
+    from gpt_2_distributed_tpu.data.synthetic import write_synthetic_shards
+
+    d = tmp_path_factory.mktemp("shards")
+    write_synthetic_shards(
+        str(d), num_shards=5, tokens_per_shard=4096, vocab_size=257, seed=1234
+    )
+    return str(d)
+
+
+@pytest.fixture(scope="session")
+def tiny_config():
+    from gpt_2_distributed_tpu.config import GPT2Config
+
+    return GPT2Config(
+        vocab_size=257,
+        n_positions=64,
+        n_embd=32,
+        n_layer=2,
+        n_head=2,
+        embd_dropout=0.0,
+        attn_dropout=0.0,
+        resid_dropout=0.0,
+    )
+
+
+@pytest.fixture(scope="session")
+def rng_np():
+    return np.random.default_rng(0)

@@ -1,0 +1,136 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpt_2_distributed_tpu.config import GPT2Config
+from gpt_2_distributed_tpu.models import gpt2
+from gpt_2_distributed_tpu.ops.activations import gelu_tanh
+
+
+def _batch(config, rng_np, b=2, t=None):
+    t = t or config.n_positions
+    x = rng_np.integers(0, config.vocab_size, (b, t)).astype(np.int32)
+    y = rng_np.integers(0, config.vocab_size, (b, t)).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_gelu_matches_openai_form():
+    x = jnp.linspace(-4, 4, 101, dtype=jnp.float32)
+    expected = jax.nn.gelu(x, approximate=True)
+    np.testing.assert_allclose(gelu_tanh(x), expected, atol=1e-6)
+
+
+def test_forward_shapes_and_loss(tiny_config, rng_np):
+    params = gpt2.init_params(tiny_config)
+    x, y = _batch(tiny_config, rng_np, b=3, t=16)
+    logits, loss = gpt2.forward(params, tiny_config, x, labels=y,
+                                compute_dtype=jnp.float32)
+    assert logits.shape == (3, 16, tiny_config.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert loss.shape == () and jnp.isfinite(loss)
+    # Random init, uniform-random labels: loss ~= ln(vocab)
+    assert abs(float(loss) - np.log(tiny_config.vocab_size)) < 1.0
+
+
+def test_param_count_matches_config_formula(tiny_config):
+    params = gpt2.init_params(tiny_config)
+    assert gpt2.count_params(params) == tiny_config.num_params()
+
+
+def test_param_count_124m():
+    # Reference asserts ~124M (/root/reference/model.py:368,378).
+    n = GPT2Config().num_params()
+    assert 124e6 < n < 125e6
+
+
+def test_init_distribution_and_seed(tiny_config):
+    p1 = gpt2.init_params(tiny_config, seed=42)
+    p2 = gpt2.init_params(tiny_config, seed=42)
+    p3 = gpt2.init_params(tiny_config, seed=7)
+    chex = jax.tree_util.tree_all(
+        jax.tree_util.tree_map(lambda a, b: bool(jnp.array_equal(a, b)), p1, p2)
+    )
+    assert chex
+    assert not bool(jnp.array_equal(p1["wte"], p3["wte"]))
+    # N(0, 0.02) weights, zero biases, unit LN scales
+    w = np.asarray(p1["block"]["attn_qkv_w"])
+    assert abs(w.std() - 0.02) < 0.004
+    assert abs(w.mean()) < 0.004
+    assert np.all(np.asarray(p1["block"]["attn_qkv_b"]) == 0)
+    assert np.all(np.asarray(p1["ln_f_scale"]) == 1)
+
+
+def test_seq_len_guard(tiny_config, rng_np):
+    params = gpt2.init_params(tiny_config)
+    x, _ = _batch(tiny_config, rng_np, b=1, t=tiny_config.n_positions + 1)
+    with pytest.raises(ValueError, match="exceeds n_positions"):
+        gpt2.forward(params, tiny_config, x)
+
+
+def test_scan_and_loop_paths_agree(tiny_config, rng_np):
+    """The lax.scan-over-layers path must compute exactly what the unrolled
+    python loop computes."""
+    params = gpt2.init_params(tiny_config)
+    x, y = _batch(tiny_config, rng_np, b=2, t=32)
+    cfg_scan = tiny_config.replace(scan_layers=True)
+    cfg_loop = tiny_config.replace(scan_layers=False)
+    l1, loss1 = gpt2.forward(params, cfg_scan, x, labels=y, compute_dtype=jnp.float32)
+    l2, loss2 = gpt2.forward(params, cfg_loop, x, labels=y, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+    np.testing.assert_allclose(float(loss1), float(loss2), atol=1e-6)
+
+
+def test_remat_matches_no_remat(tiny_config, rng_np):
+    params = gpt2.init_params(tiny_config)
+    x, y = _batch(tiny_config, rng_np, b=2, t=32)
+    _, loss_plain = gpt2.forward(params, tiny_config, x, labels=y,
+                                 compute_dtype=jnp.float32)
+    _, loss_remat = gpt2.forward(params, tiny_config.replace(remat=True), x,
+                                 labels=y, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(float(loss_plain), float(loss_remat), rtol=1e-6)
+
+
+def test_ignore_index_masking(tiny_config, rng_np):
+    params = gpt2.init_params(tiny_config)
+    x, y = _batch(tiny_config, rng_np, b=2, t=16)
+    y_masked = y.at[:, :8].set(gpt2.IGNORE_INDEX)
+    _, loss_full = gpt2.forward(params, tiny_config, x, labels=y,
+                                compute_dtype=jnp.float32)
+    logits, loss_masked = gpt2.forward(params, tiny_config, x, labels=y_masked,
+                                       compute_dtype=jnp.float32)
+    # Manual CE over the unmasked half must equal the masked loss.
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    manual = -np.mean(
+        [lp[b, t, int(y[b, t])] for b in range(2) for t in range(8, 16)]
+    )
+    np.testing.assert_allclose(float(loss_masked), manual, rtol=1e-5)
+    assert not np.isclose(float(loss_full), float(loss_masked))
+
+
+def test_dropout_active_in_training_mode(tiny_config, rng_np):
+    cfg = tiny_config.replace(embd_dropout=0.5, resid_dropout=0.5, attn_dropout=0.5)
+    params = gpt2.init_params(cfg)
+    x, y = _batch(cfg, rng_np, b=2, t=16)
+    rng = jax.random.PRNGKey(0)
+    _, l1 = gpt2.forward(params, cfg, x, labels=y, rng=rng, deterministic=False,
+                         compute_dtype=jnp.float32)
+    _, l2 = gpt2.forward(params, cfg, x, labels=y, rng=jax.random.PRNGKey(1),
+                         deterministic=False, compute_dtype=jnp.float32)
+    _, l3 = gpt2.forward(params, cfg, x, labels=y, rng=rng, deterministic=False,
+                         compute_dtype=jnp.float32)
+    assert float(l1) != float(l2)      # different rng -> different masks
+    assert float(l1) == float(l3)      # same rng -> identical
+    _, l4 = gpt2.forward(params, cfg, x, labels=y, deterministic=True,
+                         compute_dtype=jnp.float32)
+    assert float(l4) != float(l1)
+
+
+def test_bf16_compute_close_to_fp32(tiny_config, rng_np):
+    params = gpt2.init_params(tiny_config)
+    x, y = _batch(tiny_config, rng_np, b=2, t=32)
+    _, loss32 = gpt2.forward(params, tiny_config, x, labels=y,
+                             compute_dtype=jnp.float32)
+    _, loss16 = gpt2.forward(params, tiny_config, x, labels=y,
+                             compute_dtype=jnp.bfloat16)
+    assert abs(float(loss32) - float(loss16)) < 0.05
