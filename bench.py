@@ -1,0 +1,131 @@
+"""Headline benchmark: GPT-2 124M training throughput on the attached device.
+
+Prints ONE JSON line:
+    {"metric": "tokens_per_sec_per_chip", "value": N, "unit": "tok/s/chip",
+     "vs_baseline": N, ...}
+
+``vs_baseline`` is measured MFU divided by the 0.50 MFU north-star target from
+BASELINE.md (the reference publishes no numbers of its own — BASELINE.json
+records ``"published": {}`` — so the target is forward-defined). On non-TPU
+hosts (unknown peak FLOPs) ``vs_baseline`` is null.
+
+Benches the real jitted train step (dropout on, grad accumulation, AdamW
+update, donated buffers) on synthetic on-device data, so data loading is not
+measured — matching how the reference's tokens/sec metric counts only
+optimizer-step cadence (``/root/reference/stats_tracker.py:209-234``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="124M")
+    p.add_argument("--seq_len", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=0, help="micro-batch per chip; 0 = auto")
+    p.add_argument("--grad_accum_steps", type=int, default=1)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--remat", action="store_true", help="activation checkpointing")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, create_mesh
+    from gpt_2_distributed_tpu.parallel.sharding import (
+        shard_batch,
+        shard_params_and_opt_state,
+    )
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+    from gpt_2_distributed_tpu.utils.flops import device_peak_flops, flops_per_token, mfu
+
+    config = MODEL_PRESETS[args.model].replace(
+        n_positions=max(args.seq_len, 1024), remat=args.remat
+    )
+    n_chips = jax.device_count()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if args.batch:
+        micro_batch = args.batch
+    else:
+        # Dense-attention activation memory caps the micro-batch at 4 on a
+        # 16G-HBM chip (cf. the reference's identical finding on a 32G GPU,
+        # /root/reference/dataloader.py:15-17); the Pallas flash-attention path
+        # lifts this.
+        micro_batch = 4 if on_tpu else 2
+    seq_len = args.seq_len if on_tpu else min(args.seq_len, 256)
+    steps = args.steps if on_tpu else max(2, args.steps // 5)
+
+    spec = MeshSpec(data=n_chips, fsdp=1)
+    mesh = create_mesh(spec)
+    params = gpt2.init_params(config)
+    optimizer = make_optimizer(1e-4)
+
+    rng_np = np.random.default_rng(0)
+    shape = (args.grad_accum_steps, micro_batch * n_chips, seq_len)
+    x = rng_np.integers(0, config.vocab_size, shape, dtype=np.int32)
+    y = rng_np.integers(0, config.vocab_size, shape, dtype=np.int32)
+
+    with mesh:
+        params, opt_state, _ = shard_params_and_opt_state(params, optimizer, mesh)
+        step = make_train_step(config, optimizer)
+        x, y = shard_batch((x, y), mesh)
+        key = jax.random.PRNGKey(0)
+
+        for i in range(args.warmup):
+            params, opt_state, metrics = step(params, opt_state, x, y, key, i)
+        float(metrics.loss)  # materialize: full sync with the device
+
+        t0 = time.perf_counter()
+        for i in range(steps):
+            params, opt_state, metrics = step(
+                params, opt_state, x, y, key, args.warmup + i
+            )
+        # float() forces a device->host read of the last loss, which transitively
+        # depends on every step in the loop (next step's loss needs this step's
+        # params) — a plain block_until_ready proved unreliable through remote
+        # TPU tunnels.
+        final_loss = float(metrics.loss)
+        dt = time.perf_counter() - t0
+
+    tokens_per_step = args.grad_accum_steps * micro_batch * n_chips * seq_len
+    tok_s = tokens_per_step * steps / dt
+    tok_s_chip = tok_s / n_chips
+    peak = device_peak_flops()
+    measured_mfu = mfu(tok_s_chip, config, seq_len, peak)
+
+    print(
+        json.dumps(
+            {
+                "metric": "tokens_per_sec_per_chip",
+                "value": round(tok_s_chip, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(measured_mfu / 0.50, 4) if measured_mfu else None,
+                "mfu": round(measured_mfu, 4) if measured_mfu else None,
+                "model": args.model,
+                "seq_len": seq_len,
+                "micro_batch_per_chip": micro_batch,
+                "grad_accum": args.grad_accum_steps,
+                "n_chips": n_chips,
+                "device": jax.devices()[0].device_kind,
+                "flops_per_token": flops_per_token(config, seq_len),
+                "step_time_ms": round(dt / steps * 1000, 2),
+                "final_loss": round(final_loss, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

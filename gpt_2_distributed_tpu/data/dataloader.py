@@ -95,6 +95,7 @@ class TokenShardDataset:
         process_index: int | None = None,
         process_count: int | None = None,
         num_workers: int = DEFAULT_NUM_WORKERS,
+        vocab_size: int | None = None,
     ) -> None:
         if not shard_paths:
             raise ValueError("shard_paths is empty — no data to train on")
@@ -110,6 +111,13 @@ class TokenShardDataset:
         self.process_index = int(process_index)
         self.process_count = int(process_count)
         self.num_workers = max(1, int(num_workers))
+        # Optional token-id validation bound. The model's embedding gather and
+        # the loss's label gather both use clip-mode indexing (a TPU-ism:
+        # hardware gathers clamp), which would turn a corrupted shard into
+        # silently-wrong training instead of an error — so when the vocab size
+        # is known, corrupt windows are rejected here, the host-side boundary,
+        # matching the reference's hard torch CE error on bad ids.
+        self.vocab_size = vocab_size
         self._epoch = 0
 
     # Parity with the reference's set_epoch (``/root/reference/dataloader.py:162-171``).
@@ -156,7 +164,16 @@ class TokenShardDataset:
         offsets = list(range(0, n - self.seq_len - 1, self.seq_len))
         random.Random(_offset_seed(epoch, self.process_index, worker_id)).shuffle(offsets)
         for off in offsets:
-            yield np.array(tokens[off : off + self.seq_len + 1], dtype=np.uint16)
+            window = np.array(tokens[off : off + self.seq_len + 1], dtype=np.uint16)
+            if self.vocab_size is not None:
+                top = int(window.max())
+                if top >= self.vocab_size:
+                    raise ValueError(
+                        f"shard {path} contains token id {top} >= vocab_size "
+                        f"{self.vocab_size} (offset {off}); data is corrupt or "
+                        f"tokenized with a different vocabulary"
+                    )
+            yield window
 
     def iter_worker(self, worker_id: int) -> Iterator[np.ndarray]:
         """Sample stream for one worker: all its shards this epoch, in
@@ -284,18 +301,20 @@ class DataLoader:
         try:
             i = 0
             while live:
-                worker = live[i % len(live)]
+                pos = i % len(live)
+                worker = live[pos]
                 item = worker.queue.get()
                 if item is _STOP:
-                    live.remove(worker)
-                    # keep round-robin position stable relative to remaining workers
-                    i = i % max(1, len(live))
+                    # The worker after the exhausted one slides into its
+                    # position, so the rotation continues from `pos` unchanged.
+                    live.pop(pos)
+                    i = pos
                     continue
                 if isinstance(item, _WorkerError):
                     raise RuntimeError(
                         f"data worker {worker.worker_id} failed"
                     ) from item.exc
-                i += 1
+                i = pos + 1
                 if skipped < to_skip:
                     skipped += 1
                     continue

@@ -60,8 +60,11 @@ def init_distributed(
     if process_id is None:
         r = os.environ.get("PROCESS_ID") or os.environ.get("RANK")
         process_id = int(r) if r else None
-    if coordinator_address is None and num_processes is None:
-        return  # single-process: nothing to initialize
+    if coordinator_address is None or (num_processes or 1) <= 1:
+        # Single-process (or no coordinator determinable): nothing to
+        # initialize. Covers leftover WORLD_SIZE=1/RANK=0 env residue without
+        # a MASTER_ADDR, where calling jax.distributed.initialize would raise.
+        return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

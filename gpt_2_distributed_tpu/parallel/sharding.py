@@ -21,6 +21,7 @@ from typing import Any
 
 import jax
 import numpy as np
+import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gpt_2_distributed_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS
@@ -69,24 +70,44 @@ def batch_pspec(leading_accum_axis: bool = True) -> P:
     return P((DATA_AXIS, FSDP_AXIS), None)
 
 
+def opt_state_pspecs(
+    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh
+) -> Any:
+    """PartitionSpec tree for the optimizer state: every param-shaped moment
+    (AdamW mu/nu) gets its parameter's spec, every non-param leaf (step
+    counters) is replicated. This is ZeRO-1/2 semantics — optimizer state is
+    sharded exactly as far as params are."""
+    pspecs = param_pspecs(params, mesh)
+    state_shapes = jax.eval_shape(optimizer.init, params)
+    return optax.tree_map_params(
+        optimizer,
+        lambda _leaf, spec: spec,
+        state_shapes,
+        pspecs,
+        transform_non_params=lambda _leaf: P(),
+    )
+
+
 def shard_params_and_opt_state(
-    params: Any, optimizer, mesh: Mesh
+    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh
 ) -> tuple[Any, Any, Any]:
     """Place params on the mesh per the param rule and build the optimizer
-    state already-sharded: ``optimizer.init`` runs under jit with sharded
-    params as input, so XLA lays every moment buffer out exactly like its
-    parameter (ZeRO-1/2 for free — optimizer state is sharded whenever params
-    are).
+    state sharded like its params. The moment shardings are enforced with
+    explicit ``out_shardings`` — jit does NOT propagate input shardings to
+    outputs reliably (XLA may replicate them), which would silently give up
+    ZeRO and triple per-device optimizer memory.
 
     Returns ``(sharded_params, sharded_opt_state, param_shardings)``.
     """
     pspecs = param_pspecs(params, mesh)
-    shardings = jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), pspecs,
+    to_sharding = lambda tree: jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), tree,
         is_leaf=lambda x: isinstance(x, P),
     )
+    shardings = to_sharding(pspecs)
     params = jax.device_put(params, shardings)
-    opt_state = jax.jit(optimizer.init)(params)
+    opt_shardings = to_sharding(opt_state_pspecs(params, optimizer, mesh))
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
     return params, opt_state, shardings
 
 

@@ -1,0 +1,7 @@
+from gpt_2_distributed_tpu.utils.flops import (
+    device_peak_flops,
+    flops_per_token,
+    mfu,
+)
+
+__all__ = ["device_peak_flops", "flops_per_token", "mfu"]

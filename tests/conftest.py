@@ -26,6 +26,13 @@ jax.config.update("jax_platforms", "cpu")
 # error on unit-scale fp32 matmuls); golden-parity tests need real fp32.
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Fail fast if the virtual 8-device platform did not take effect — otherwise
+# every sharding test silently degenerates to a replicated single-device mesh
+# and the parallelism layer ships unverified.
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.device_count()}: {jax.devices()}"
+)
+
 
 @pytest.fixture(scope="session")
 def shard_dir(tmp_path_factory):
