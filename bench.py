@@ -34,6 +34,8 @@ def main() -> None:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--remat", action="store_true", help="activation checkpointing")
     args = p.parse_args()
+    args.steps = max(1, args.steps)
+    args.warmup = max(1, args.warmup)  # first call doubles as the compile step
 
     import jax
     import jax.numpy as jnp

@@ -1,0 +1,15 @@
+from gpt_2_distributed_tpu.metrics.registry import (
+    METRIC_REGISTRY,
+    MetricDefinition,
+    MetricRegistry,
+    ReductionStrategy,
+)
+from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
+
+__all__ = [
+    "METRIC_REGISTRY",
+    "MetricDefinition",
+    "MetricRegistry",
+    "ReductionStrategy",
+    "StatsTracker",
+]

@@ -1,0 +1,146 @@
+"""Built-in metrics: the reference's 13 plus TPU-native MFU accounting.
+
+Mirrors the observable metric surface of ``/root/reference/stats_tracker.py``:
+
+* freq-1 ``train/``: loss (avg, distributed), lr (current), grad_norm (avg,
+  distributed), epoch (current), batch (current, int) — ``:142-206``
+* freq-1 ``perf/``: tokens_per_second (collector), total_tokens, epoch_time —
+  ``:237-274``
+* freq-20 ``mem/``: device alloc/peak/utilization + host CPU RSS — ``:302-364``,
+  with the CUDA allocator stats replaced by ``jax.local_devices()[i]
+  .memory_stats()`` (XLA's HBM accounting; there is no reserved-vs-allocated
+  split on TPU — HBM is planned at compile time — so ``gpu_reserved_gb`` maps
+  to the allocator's bytes_limit).
+
+TPU-native additions (BASELINE.md's headline metrics, absent in the
+reference): ``perf/tokens_per_second_per_chip`` and ``perf/mfu``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import TYPE_CHECKING
+
+from gpt_2_distributed_tpu.metrics.registry import (
+    METRIC_REGISTRY,
+    ReductionStrategy,
+)
+
+if TYPE_CHECKING:
+    from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
+
+GB = 1024**3
+MB = 1024**2
+
+
+# --- freq-1 training metrics (pushed by the driver through update()) -------
+
+METRIC_REGISTRY.metric(
+    "loss", reduction=ReductionStrategy.AVERAGE, distributed=True,
+    cli_format="loss: {value:.4f}",
+)(float)
+
+METRIC_REGISTRY.metric(
+    "lr", reduction=ReductionStrategy.CURRENT, cli_format="lr: {value:.2e}",
+)(float)
+
+METRIC_REGISTRY.metric(
+    "grad_norm", reduction=ReductionStrategy.AVERAGE, distributed=True,
+    cli_format="grad_norm: {value:.4f}",
+)(float)
+
+METRIC_REGISTRY.metric(
+    "epoch", reduction=ReductionStrategy.CURRENT, cli_format="epoch: {value:.0f}",
+)(float)
+
+METRIC_REGISTRY.metric(
+    "batch", reduction=ReductionStrategy.CURRENT, cli_format="batch: {value:.0f}",
+)(lambda v: float(int(v)))
+
+
+# --- freq-1 performance collector ------------------------------------------
+
+
+def collect_performance(tracker: "StatsTracker") -> dict[str, float]:
+    """Windowed throughput + totals, pulled each step
+    (``/root/reference/stats_tracker.py:209-234``): tokens accumulated since
+    the last CLI tick divided by elapsed wall-clock, plus run totals. Extends
+    the reference with per-chip throughput and MFU."""
+    now = time.perf_counter()
+    dt = max(now - tracker.window_start_time, 1e-9)
+    tok_s = tracker.window_tokens / dt
+    out = {
+        "tokens_per_second": tok_s,
+        "total_tokens": float(tracker.total_tokens),
+        "epoch_time": now - tracker.epoch_start_time,
+        "tokens_per_second_per_chip": tok_s / max(tracker.n_chips, 1),
+    }
+    if tracker.flops_per_token and tracker.peak_flops_per_chip:
+        out["mfu"] = (
+            out["tokens_per_second_per_chip"]
+            * tracker.flops_per_token
+            / tracker.peak_flops_per_chip
+        )
+    return out
+
+
+for _name, _red, _fmt in (
+    # The reference declares tokens_per_second with ReductionStrategy.SUM but
+    # its cross-rank reduce is always a mean (SURVEY.md C21); here the window
+    # reduction is what SUM governs, and the tracker multiplies the
+    # cross-process mean by process_count to report true system throughput.
+    ("tokens_per_second", ReductionStrategy.CURRENT, "tok/s: {value:,.0f}"),
+    ("total_tokens", ReductionStrategy.CURRENT, "total_tok: {value:,.0f}"),
+    ("epoch_time", ReductionStrategy.CURRENT, "epoch_s: {value:.1f}"),
+    ("tokens_per_second_per_chip", ReductionStrategy.CURRENT, "tok/s/chip: {value:,.0f}"),
+    ("mfu", ReductionStrategy.CURRENT, "mfu: {value:.1%}"),
+):
+    METRIC_REGISTRY.metric(
+        _name, reduction=_red, tb_prefix="perf/", cli_format=_fmt, collector=True,
+    )(collect_performance)
+
+
+# --- freq-20 memory collector ----------------------------------------------
+
+
+def collect_memory(tracker: "StatsTracker") -> dict[str, float]:
+    """Device HBM + host RSS (``/root/reference/stats_tracker.py:277-299``),
+    via XLA's per-device allocator stats instead of the CUDA caching
+    allocator."""
+    out: dict[str, float] = {}
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        in_use = stats.get("bytes_in_use", 0)
+        limit = stats.get("bytes_limit", 0)
+        peak = stats.get("peak_bytes_in_use", in_use)
+        out["device_alloc_gb"] = in_use / GB
+        out["device_limit_gb"] = limit / GB
+        out["device_peak_alloc_gb"] = peak / GB
+        if limit:
+            out["device_utilization_pct"] = 100.0 * in_use / limit
+    try:
+        import psutil
+
+        out["cpu_mb"] = psutil.Process(os.getpid()).memory_info().rss / MB
+    except Exception:
+        pass
+    return out
+
+
+for _name, _red, _fmt in (
+    ("device_alloc_gb", ReductionStrategy.AVERAGE, "hbm: {value:.2f}GB"),
+    ("device_limit_gb", ReductionStrategy.CURRENT, None),
+    ("device_peak_alloc_gb", ReductionStrategy.MAX, "hbm_peak: {value:.2f}GB"),
+    ("device_utilization_pct", ReductionStrategy.AVERAGE, "hbm_util: {value:.0f}%"),
+    ("cpu_mb", ReductionStrategy.SUM, "cpu: {value:.0f}MB"),
+):
+    METRIC_REGISTRY.metric(
+        _name, frequency=20, reduction=_red, tb_prefix="mem/",
+        cli_format=_fmt, collector=True,
+    )(collect_memory)

@@ -1,0 +1,223 @@
+"""StatsTracker: buffered, windowed, two-sink metric runtime.
+
+Observable behavior matches the reference's ``StatsTracker``
+(``/root/reference/stats_tracker.py:367-639``): values pushed via
+``update(step, **metrics)`` are processed, cross-process mean-reduced when the
+metric is declared distributed, and buffered into per-metric windows (deque,
+maxlen 50); pull-style collectors run at their declared frequencies into a
+cached-metrics dict; TensorBoard gets window-reduced buffered metrics plus raw
+cached metrics every ``tb_every`` steps (writer flushed at ≥30 s intervals);
+the CLI gets one formatted line of training metrics every ``cli_every`` steps
+with memory metrics grouped on their own ``MEMORY:`` line; the token-rate
+window resets at each CLI tick.
+
+Deliberate deviations, recorded for the parity ledger:
+
+* Cross-process reduction is a **mean** for every distributed metric — the
+  reference's ``_all_reduce_scalar`` sums then divides by world size
+  regardless of the metric's declared strategy (``:25-34``; SURVEY.md C21).
+* The driver passes the **global** effective batch size (micro-batch x
+  grad_accum x data-parallel degree), so ``tokens_per_second`` is true system
+  throughput with no cross-process reduction — fixing the reference's
+  "total system throughput" docstring lie (its TB value is the cross-rank
+  *mean per-worker* rate) without per-step host synchronization.
+* Reduction runs on host scalars via a jitted psum over processes
+  (`multihost_utils`), not NCCL; single-process it is the identity and free.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable
+
+from gpt_2_distributed_tpu.metrics import builtin as _builtin  # noqa: F401  (registers built-ins)
+from gpt_2_distributed_tpu.metrics.registry import (
+    METRIC_REGISTRY,
+    MetricDefinition,
+    MetricRegistry,
+)
+
+WINDOW_SIZE = 50          # reference deque maxlen, stats_tracker.py:404-409
+TB_FLUSH_INTERVAL_S = 30  # reference flush cadence, stats_tracker.py:563-594
+
+
+def _default_reduce(values: dict[str, float]) -> dict[str, float]:
+    """Cross-process mean of each scalar. Identity when single-process."""
+    import jax
+
+    if jax.process_count() == 1:
+        return values
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    keys = sorted(values)
+    arr = np.asarray([values[k] for k in keys], dtype=np.float64)
+    summed = multihost_utils.process_allgather(arr).sum(axis=0)
+    return {k: float(s / jax.process_count()) for k, s in zip(keys, summed)}
+
+
+class StatsTracker:
+    """Training metrics runtime with TensorBoard + CLI sinks.
+
+    Construction signature mirrors the reference
+    (``/root/reference/stats_tracker.py:379-403``): ``batch_size`` is the
+    *effective* batch (micro-batch x grad_accum — the driver passes it that
+    way, ``train_gpt2_distributed.py:367``), and ``tokens_per_step =
+    batch_size x seq_len``.
+    """
+
+    def __init__(
+        self,
+        tb_dir: str | None,
+        batch_size: int,
+        seq_len: int,
+        world_size: int | None = None,
+        tb_every: int = 1,
+        cli_every: int = 20,
+        registry: MetricRegistry = METRIC_REGISTRY,
+        reduce_fn: Callable[[dict[str, float]], dict[str, float]] | None = None,
+        flops_per_token: float | None = None,
+        peak_flops_per_chip: float | None = None,
+        n_chips: int | None = None,
+        print_fn: Callable[[str], None] = print,
+        is_primary: bool | None = None,
+    ) -> None:
+        import jax
+
+        self.registry = registry
+        self.tb_every = max(1, int(tb_every))
+        self.cli_every = max(1, int(cli_every))
+        self.world_size = world_size if world_size is not None else jax.process_count()
+        self.n_chips = n_chips if n_chips is not None else jax.device_count()
+        self.tokens_per_step = int(batch_size) * int(seq_len)
+        self.flops_per_token = flops_per_token
+        self.peak_flops_per_chip = peak_flops_per_chip
+        self.reduce_fn = reduce_fn if reduce_fn is not None else _default_reduce
+        self.print_fn = print_fn
+        if is_primary is None:
+            is_primary = jax.process_index() == 0
+        self.is_primary = is_primary
+
+        self.buffers: dict[str, deque] = {}
+        self.cached_metrics: dict[str, float] = {}
+        self.total_tokens = 0
+        self.window_tokens = 0
+        self.window_start_time = time.perf_counter()
+        self.epoch_start_time = time.perf_counter()
+        self.current_epoch = 0
+        self._last_flush = time.perf_counter()
+
+        self.writer = None
+        if tb_dir and self.is_primary:
+            from tensorboardX import SummaryWriter
+
+            self.writer = SummaryWriter(log_dir=tb_dir)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start_epoch(self, epoch: int | None = None) -> None:
+        """Reset epoch wall-clock (``/root/reference/stats_tracker.py:435-443``)."""
+        if epoch is not None:
+            self.current_epoch = int(epoch)
+        self.epoch_start_time = time.perf_counter()
+        self.window_start_time = time.perf_counter()
+        self.window_tokens = 0
+
+    def update(self, step: int, **metrics: Any) -> None:
+        """Record one optimizer step's metrics
+        (``/root/reference/stats_tracker.py:501-561``)."""
+        # 1. process + cross-process reduce + buffer pushed metrics
+        processed: dict[str, float] = {}
+        to_reduce: dict[str, float] = {}
+        for name, value in metrics.items():
+            d = self.registry.get(name)
+            if d is None:
+                continue
+            v = float(d.processor(value)) if d.processor else float(value)
+            if d.distributed and self.world_size > 1:
+                to_reduce[name] = v
+            else:
+                processed[name] = v
+        if to_reduce:
+            processed.update(self.reduce_fn(to_reduce))
+        for name, v in processed.items():
+            self._buffer(name, v)
+
+        # 2. token accounting (:538-540)
+        self.total_tokens += self.tokens_per_step
+        self.window_tokens += self.tokens_per_step
+
+        # 3. due pull-collectors -> cached metrics (:542-548)
+        for d in self.registry.due_collectors(step):
+            collected = d.collector(self)
+            for name, v in collected.items():
+                if name not in self.registry:
+                    continue
+                self.cached_metrics[name] = float(v)
+                self._buffer(name, float(v))
+
+        # 4. sinks on independent cadences (:550-561)
+        if self.writer is not None and step % self.tb_every == 0:
+            self._write_tensorboard(step)
+        if step % self.cli_every == 0:
+            if self.is_primary:
+                self._print_cli(step)
+            # token-rate window resets at each CLI tick (:558-561)
+            self.window_tokens = 0
+            self.window_start_time = time.perf_counter()
+
+    def close(self) -> None:
+        """Flush and release the TB writer (``:634-639``)."""
+        if self.writer is not None:
+            self.writer.flush()
+            self.writer.close()
+            self.writer = None
+
+    # -- internals ----------------------------------------------------------
+
+    def _buffer(self, name: str, value: float) -> None:
+        if name not in self.buffers:
+            self.buffers[name] = deque(maxlen=WINDOW_SIZE)
+        self.buffers[name].append(value)
+
+    def _window_value(self, d: MetricDefinition) -> float | None:
+        buf = self.buffers.get(d.name)
+        if not buf:
+            return None
+        return d.reduction.reduce(list(buf))
+
+    def _write_tensorboard(self, step: int) -> None:
+        """Window-reduced buffered metrics + raw cached metrics
+        (``/root/reference/stats_tracker.py:563-594``)."""
+        for d in self.registry.all():
+            if d.name in self.cached_metrics:
+                self.writer.add_scalar(d.tb_tag, self.cached_metrics[d.name], step)
+            else:
+                v = self._window_value(d)
+                if v is not None:
+                    self.writer.add_scalar(d.tb_tag, v, step)
+        now = time.perf_counter()
+        if now - self._last_flush >= TB_FLUSH_INTERVAL_S:
+            self.writer.flush()
+            self._last_flush = now
+
+    def _print_cli(self, step: int) -> None:
+        """Training metrics on one line, memory on its own ``MEMORY:`` line
+        (``/root/reference/stats_tracker.py:596-632``)."""
+        main_parts, mem_parts = [], []
+        for d in self.registry.all():
+            if d.cli_format is None:
+                continue
+            if d.name in self.cached_metrics:
+                v: float | None = self.cached_metrics[d.name]
+            else:
+                v = self._window_value(d)
+            if v is None:
+                continue
+            text = d.cli_format.format(name=d.name, value=v)
+            (mem_parts if d.tb_prefix == "mem/" else main_parts).append(text)
+        if main_parts:
+            self.print_fn(f"step {step:>7d} | " + " | ".join(main_parts))
+        if mem_parts:
+            self.print_fn(f"MEMORY: " + " | ".join(mem_parts))

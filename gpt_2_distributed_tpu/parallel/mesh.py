@@ -60,10 +60,12 @@ def init_distributed(
     if process_id is None:
         r = os.environ.get("PROCESS_ID") or os.environ.get("RANK")
         process_id = int(r) if r else None
-    if coordinator_address is None or (num_processes or 1) <= 1:
-        # Single-process (or no coordinator determinable): nothing to
-        # initialize. Covers leftover WORLD_SIZE=1/RANK=0 env residue without
-        # a MASTER_ADDR, where calling jax.distributed.initialize would raise.
+    if coordinator_address is None or (num_processes is not None and num_processes <= 1):
+        # No coordinator (covers leftover WORLD_SIZE=1/RANK=0 env residue
+        # without a MASTER_ADDR, where initialize would raise) or an
+        # explicitly single-process job: nothing to initialize. A coordinator
+        # with num_processes unset DOES initialize — jax auto-detects the
+        # process count on Cloud TPU.
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -88,6 +88,21 @@ def opt_state_pspecs(
     )
 
 
+def _to_named(tree: Any, mesh: Mesh) -> Any:
+    """PartitionSpec tree -> NamedSharding tree."""
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def opt_state_shardings(
+    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh
+) -> Any:
+    """NamedSharding tree for the optimizer state (see opt_state_pspecs)."""
+    return _to_named(opt_state_pspecs(params, optimizer, mesh), mesh)
+
+
 def shard_params_and_opt_state(
     params: Any, optimizer: optax.GradientTransformation, mesh: Mesh
 ) -> tuple[Any, Any, Any]:
@@ -99,14 +114,9 @@ def shard_params_and_opt_state(
 
     Returns ``(sharded_params, sharded_opt_state, param_shardings)``.
     """
-    pspecs = param_pspecs(params, mesh)
-    to_sharding = lambda tree: jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), tree,
-        is_leaf=lambda x: isinstance(x, P),
-    )
-    shardings = to_sharding(pspecs)
+    shardings = _to_named(param_pspecs(params, mesh), mesh)
     params = jax.device_put(params, shardings)
-    opt_shardings = to_sharding(opt_state_pspecs(params, optimizer, mesh))
+    opt_shardings = opt_state_shardings(params, optimizer, mesh)
     opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
     return params, opt_state, shardings
 

@@ -1,0 +1,322 @@
+"""Training driver CLI.
+
+Flag surface mirrors the reference's argparse CLI
+(``/root/reference/train_gpt2_distributed.py:282-310``) so its launch scripts
+translate 1:1 — ``--data_dir --training_mode --seq_len --batch
+--grad_accum_steps --epochs --lr --save_every --save_dir --log_dir --workers``
+— extended with what the reference hard-codes or lacks: ``--model`` size
+presets (124M..1.5B, SURVEY.md §5.6), ``--mesh`` for explicit
+data/fsdp mesh shapes, ``--resume`` (the reference's load_checkpoint is an
+empty stub, ``:104-111``), ``--lr_schedule/--warmup_steps`` (its LR scheduler
+is a TODO, ``:354``), ``--profile`` (jax.profiler traces into the same
+TensorBoard log dir), and ``--max_steps`` for smoke runs.
+
+Execution model (one jitted step, every mode a sharding):
+    batches [grad_accum, micro_batch, seq] -> train_step (lax.scan grad accum,
+    AdamW, bf16 compute / fp32 params) -> StatsTracker -> periodic sharded
+    checkpoint. Loop structure follows the reference driver
+    (``:194-473``): epoch loop, set_epoch, per-optimizer-step metrics update,
+    save every ``--save_every`` steps plus a final save.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any
+
+import numpy as np
+
+from gpt_2_distributed_tpu.config import MODEL_PRESETS
+from gpt_2_distributed_tpu.data.dataloader import (
+    DEFAULT_BATCH_SIZE,
+    DEFAULT_CONTEXT_LENGTH,
+    DEFAULT_NUM_WORKERS,
+    DEFAULT_PREFETCH_FACTOR,
+    TokenShardDataset,
+    create_dataloader,
+    get_shard_paths,
+)
+
+DEFAULT_SEED = 42  # reference global seed, /root/reference/train_gpt2_distributed.py:39
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gpt_2_distributed_tpu.train",
+        description="GPT-2 pretraining on TPU (JAX/XLA); capability parity "
+        "with dpickem/gpt_2_distributed's train_gpt2_distributed.py",
+    )
+    p.add_argument("--data_dir", required=True, help="directory of uint16 .bin token shards")
+    p.add_argument("--split", default="train")
+    p.add_argument(
+        "--training_mode", default="local", choices=["local", "dp", "ddp", "fsdp"],
+        help="execution mode; all modes are sharding configs of one jitted step",
+    )
+    p.add_argument(
+        "--mesh", default=None,
+        help="explicit mesh shape 'data=K,fsdp=N' (overrides --training_mode)",
+    )
+    p.add_argument("--model", default="124M", choices=sorted(MODEL_PRESETS))
+    # Architecture overrides on top of the preset (smoke tests / ablations);
+    # the reference exposes no size control at all (SURVEY.md §5.6).
+    p.add_argument("--n_layer", type=int, default=None)
+    p.add_argument("--n_embd", type=int, default=None)
+    p.add_argument("--n_head", type=int, default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--seq_len", type=int, default=DEFAULT_CONTEXT_LENGTH)
+    p.add_argument(
+        "--batch", type=int, default=DEFAULT_BATCH_SIZE,
+        help="per-DEVICE micro-batch size (the reference's --batch is "
+        "per-GPU, /root/reference/train_gpt2_distributed.py:297; the global "
+        "micro-batch is batch x mesh devices)",
+    )
+    p.add_argument("--grad_accum_steps", type=int, default=4)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--lr_schedule", default="constant", choices=["constant", "cosine"])
+    p.add_argument("--warmup_steps", type=int, default=0)
+    p.add_argument("--max_steps", type=int, default=0, help="stop after N optimizer steps (0 = no cap)")
+    p.add_argument("--weight_decay", type=float, default=0.1)
+    p.add_argument("--save_every", type=int, default=1000)
+    p.add_argument("--save_dir", default=None)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--workers", type=int, default=DEFAULT_NUM_WORKERS)
+    p.add_argument("--prefetch_factor", type=int, default=DEFAULT_PREFETCH_FACTOR)
+    p.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    p.add_argument("--resume", action="store_true", help="resume from latest checkpoint in --save_dir")
+    p.add_argument("--remat", action="store_true", help="activation checkpointing")
+    p.add_argument("--profile", action="store_true", help="jax.profiler trace into --log_dir")
+    p.add_argument("--cli_every", type=int, default=20)
+    p.add_argument("--tb_every", type=int, default=1)
+    p.add_argument("--coordinator_address", default=None)
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    return p
+
+
+def make_lr_schedule(args, steps_per_epoch: int):
+    import optax
+
+    total = args.max_steps or max(1, steps_per_epoch * args.epochs)
+    if args.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=args.lr,
+            warmup_steps=args.warmup_steps,
+            decay_steps=total,
+            end_value=args.lr * 0.1,
+        )
+    if args.warmup_steps:
+        return optax.linear_schedule(0.0, args.lr, args.warmup_steps)
+    return args.lr
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+
+    from gpt_2_distributed_tpu.parallel.mesh import (
+        MeshSpec,
+        create_mesh,
+        init_distributed,
+        is_primary,
+    )
+
+    init_distributed(args.coordinator_address, args.num_processes, args.process_id)
+
+    import jax
+
+    from gpt_2_distributed_tpu import checkpoint as ckpt
+    from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.sharding import (
+        opt_state_shardings,
+        shard_batch,
+        shard_params_and_opt_state,
+    )
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+    from gpt_2_distributed_tpu.utils.flops import device_peak_flops, flops_per_token
+
+    # --- config ------------------------------------------------------------
+    overrides = {
+        k: getattr(args, k)
+        for k in ("n_layer", "n_embd", "n_head", "vocab_size")
+        if getattr(args, k) is not None
+    }
+    config = MODEL_PRESETS[args.model].replace(
+        n_positions=args.seq_len, remat=args.remat, **overrides
+    )
+
+    # --- mesh ---------------------------------------------------------------
+    spec = MeshSpec.parse(args.mesh) if args.mesh else MeshSpec.for_mode(args.training_mode)
+    mesh = create_mesh(spec)
+    # --batch is per device (DDP parity: the reference's --batch is per GPU
+    # process); each host's loader assembles the slice its local devices own.
+    devices_per_process = max(1, spec.n_devices // jax.process_count())
+    local_batch = args.batch * devices_per_process
+
+    # --- data --------------------------------------------------------------
+    shard_paths = get_shard_paths(args.data_dir, args.split)
+    dataset = TokenShardDataset(
+        shard_paths,
+        seq_len=args.seq_len,
+        num_workers=args.workers,
+        vocab_size=config.vocab_size,
+    )
+    # One optimizer step consumes grad_accum local micro-batches.
+    steps_per_epoch = dataset.batches_per_epoch(local_batch) // args.grad_accum_steps
+    if is_primary():
+        print(
+            f"devices: {jax.device_count()} ({jax.devices()[0].device_kind}) | "
+            f"mesh: data={spec.data}, fsdp={spec.fsdp} | model: {args.model} "
+            f"({config.num_params()/1e6:.1f}M params) | "
+            f"steps/epoch: {steps_per_epoch}"
+        )
+
+    schedule = make_lr_schedule(args, steps_per_epoch)
+    optimizer = make_optimizer(schedule, weight_decay=args.weight_decay)
+    params = gpt2.init_params(config, seed=args.seed)
+
+    with mesh:
+        params, opt_state, param_shardings = shard_params_and_opt_state(
+            params, optimizer, mesh
+        )
+        train_step = make_train_step(config, optimizer)
+
+        # --- resume ---------------------------------------------------------
+        start_epoch, skip_steps, global_step, total_tokens = 0, 0, 0, 0
+        if args.resume and args.save_dir:
+            latest = ckpt.latest_checkpoint(args.save_dir)
+            if latest is not None:
+                params, opt_state, meta = ckpt.restore_checkpoint(
+                    latest, params, opt_state, param_shardings,
+                    opt_state_shardings(params, optimizer, mesh),
+                )
+                start_epoch = meta.epoch
+                skip_steps = meta.batches_in_epoch
+                global_step = meta.step
+                total_tokens = meta.total_tokens
+                if is_primary():
+                    print(
+                        f"resumed from {latest}: step {global_step}, epoch "
+                        f"{start_epoch}, {skip_steps} steps into the epoch"
+                    )
+            elif is_primary():
+                print(f"--resume: no checkpoint found in {args.save_dir}; starting fresh")
+
+        # --- tracker ---------------------------------------------------------
+        global_batch = args.batch * spec.n_devices * args.grad_accum_steps
+        tracker = StatsTracker(
+            args.log_dir,
+            batch_size=global_batch,
+            seq_len=args.seq_len,
+            tb_every=args.tb_every,
+            cli_every=args.cli_every,
+            flops_per_token=flops_per_token(config, args.seq_len),
+            peak_flops_per_chip=device_peak_flops(),
+        )
+        tracker.total_tokens = total_tokens
+
+        if args.profile and args.log_dir:
+            jax.profiler.start_trace(os.path.join(args.log_dir, "profile"))
+
+        rng = jax.random.PRNGKey(args.seed)
+        lr_of = schedule if callable(schedule) else (lambda _s: args.lr)
+
+        # --- epoch/step loop --------------------------------------------------
+        # Metrics are consumed with a one-step lag: step N+1 is dispatched
+        # (async) before step N's loss is read back, so the host->device
+        # pipeline never drains on the device-to-host sync — the reference
+        # pays that sync every step via loss.item(). The logged step index is
+        # exact; only the wall-clock moment of logging shifts.
+        pending: tuple[int, int, int, Any] | None = None
+
+        def flush_pending() -> None:
+            nonlocal pending
+            if pending is None:
+                return
+            p_step, p_epoch, p_batch, p_m = pending
+            pending = None
+            tracker.update(
+                p_step,
+                loss=float(p_m.loss),
+                lr=float(lr_of(p_step)),
+                grad_norm=float(p_m.grad_norm),
+                epoch=p_epoch,
+                batch=p_batch,
+            )
+
+        done = False
+        epoch, step_in_epoch = start_epoch, skip_steps
+        for epoch in range(start_epoch, args.epochs):
+            dataset.set_epoch(epoch)
+            tracker.start_epoch(epoch)
+            loader = create_dataloader(
+                dataset,
+                batch_size=local_batch,
+                prefetch_factor=args.prefetch_factor,
+                skip_batches=(skip_steps * args.grad_accum_steps) if epoch == start_epoch else 0,
+            )
+            step_in_epoch = skip_steps if epoch == start_epoch else 0
+            skip_for_this_epoch = step_in_epoch
+
+            micro: list[tuple[np.ndarray, np.ndarray]] = []
+            for xb, yb in loader:
+                micro.append((xb, yb))
+                if len(micro) < args.grad_accum_steps:
+                    continue
+                x = np.stack([m[0] for m in micro])
+                y = np.stack([m[1] for m in micro])
+                micro = []
+                x, y = shard_batch((x, y), mesh)
+                params, opt_state, m = train_step(
+                    params, opt_state, x, y, rng, global_step
+                )
+                global_step += 1
+                step_in_epoch += 1
+                flush_pending()
+                pending = (global_step, epoch, step_in_epoch, m)
+
+                if args.save_dir and args.save_every and global_step % args.save_every == 0:
+                    flush_pending()
+                    ckpt.save_checkpoint(
+                        args.save_dir, global_step, params, opt_state,
+                        ckpt.CheckpointMeta(
+                            step=global_step, epoch=epoch,
+                            batches_in_epoch=step_in_epoch,
+                            rng_seed=args.seed,
+                            total_tokens=tracker.total_tokens,
+                        ),
+                    )
+                if args.max_steps and global_step >= args.max_steps:
+                    done = True
+                    break
+            if done:
+                break
+            skip_steps = 0  # later epochs start from batch 0
+
+        # --- teardown ---------------------------------------------------------
+        flush_pending()
+        if args.profile and args.log_dir:
+            jax.profiler.stop_trace()
+        if args.save_dir:
+            ckpt.save_checkpoint(
+                args.save_dir, global_step, params, opt_state,
+                ckpt.CheckpointMeta(
+                    step=global_step,
+                    epoch=min(epoch, args.epochs - 1) if args.epochs else 0,
+                    batches_in_epoch=step_in_epoch,
+                    rng_seed=args.seed,
+                    total_tokens=tracker.total_tokens,
+                ),
+            )
+        tracker.close()
+        if is_primary():
+            print(f"training done: {global_step} optimizer steps")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,13 +6,19 @@ surface is exercised in CI with no TPU attached.
 """
 
 import os
+import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force exactly 8 virtual devices, replacing any pre-existing count in the
+# environment (a mismatched count would trip the device assert below and
+# error the whole session).
+_flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", ""),
+).strip()
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8"
+).strip()
 
 import jax
 import numpy as np

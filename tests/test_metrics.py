@@ -1,0 +1,184 @@
+"""Metrics subsystem tests: registry semantics, tracker windows/cadences,
+TB + CLI sink behavior — the observable contract of the reference's
+stats_tracker (SURVEY.md C19-C22), which the reference itself never tests.
+"""
+
+import glob
+import os
+import time
+
+import pytest
+
+from gpt_2_distributed_tpu.metrics.registry import (
+    METRIC_REGISTRY,
+    MetricDefinition,
+    MetricRegistry,
+    ReductionStrategy,
+)
+from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
+
+
+class TestReductionStrategy:
+    def test_all_strategies(self):
+        vals = [1.0, 2.0, 4.0]
+        assert ReductionStrategy.AVERAGE.reduce(vals) == pytest.approx(7 / 3)
+        assert ReductionStrategy.SUM.reduce(vals) == 7.0
+        assert ReductionStrategy.CURRENT.reduce(vals) == 4.0
+        assert ReductionStrategy.MAX.reduce(vals) == 4.0
+        assert ReductionStrategy.MIN.reduce(vals) == 1.0
+
+    def test_empty_window_raises(self):
+        with pytest.raises(ValueError):
+            ReductionStrategy.AVERAGE.reduce([])
+
+
+class TestRegistry:
+    def test_decorator_registers_processor(self):
+        reg = MetricRegistry()
+
+        @reg.metric("foo", cli_format="foo={value}")
+        def process(v):
+            return v * 2
+
+        d = reg.get("foo")
+        assert d is not None and d.processor(3) == 6
+        assert "foo" in reg
+
+    def test_duplicate_rejected(self):
+        reg = MetricRegistry()
+        reg.register(MetricDefinition(name="x"))
+        with pytest.raises(ValueError):
+            reg.register(MetricDefinition(name="x"))
+
+    def test_collector_dedup_and_frequency(self):
+        reg = MetricRegistry()
+
+        def coll(tracker):
+            return {"a": 1.0, "b": 2.0}
+
+        reg.metric("a", frequency=5, collector=True)(coll)
+        reg.metric("b", frequency=5, collector=True)(coll)
+        assert len(reg.collectors()) == 1
+        assert reg.due_collectors(5) and not reg.due_collectors(3)
+
+    def test_builtin_surface(self):
+        # The reference's 13 metrics (SURVEY.md C20) plus the TPU additions.
+        for name in (
+            "loss", "lr", "grad_norm", "epoch", "batch",
+            "tokens_per_second", "total_tokens", "epoch_time",
+            "device_alloc_gb", "device_peak_alloc_gb",
+            "device_utilization_pct", "cpu_mb",
+            "tokens_per_second_per_chip", "mfu",
+        ):
+            assert name in METRIC_REGISTRY, name
+
+
+def make_tracker(tmp_path=None, **kw):
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("seq_len", 64)
+    kw.setdefault("world_size", 1)
+    kw.setdefault("is_primary", True)
+    return StatsTracker(str(tmp_path) if tmp_path else None, **kw)
+
+
+class TestTracker:
+    def test_token_accounting(self):
+        lines = []
+        t = make_tracker(print_fn=lines.append, cli_every=20)
+        for s in range(1, 41):
+            t.update(s, loss=1.0)
+        assert t.total_tokens == 40 * 16 * 64
+        # window reset at each CLI tick (steps 20 and 40)
+        assert t.window_tokens == 0
+
+    def test_window_reduction_average_vs_current(self):
+        t = make_tracker()
+        for s, (loss, lr) in enumerate([(4.0, 1e-4), (2.0, 2e-4)], start=1):
+            t.update(s, loss=loss, lr=lr)
+        d_loss = t.registry.get("loss")
+        d_lr = t.registry.get("lr")
+        assert t._window_value(d_loss) == pytest.approx(3.0)   # AVERAGE
+        assert t._window_value(d_lr) == pytest.approx(2e-4)    # CURRENT
+
+    def test_window_maxlen_50(self):
+        t = make_tracker()
+        for s in range(1, 101):
+            t.update(s, loss=float(s))
+        buf = t.buffers["loss"]
+        assert len(buf) == 50 and buf[0] == 51.0
+
+    def test_cli_cadence_and_format(self):
+        lines = []
+        t = make_tracker(print_fn=lines.append, cli_every=2)
+        t.update(1, loss=3.5)
+        assert lines == []  # step 1 % 2 != 0
+        t.update(2, loss=3.5)
+        main = [l for l in lines if l.startswith("step")]
+        assert len(main) == 1
+        assert "loss: 3.5000" in main[0]
+        # memory metrics on their own MEMORY: line, never the main line
+        mem = [l for l in lines if l.startswith("MEMORY:")]
+        if mem:
+            assert "cpu" in mem[0] or "hbm" in mem[0]
+            assert "loss" not in mem[0]
+
+    def test_perf_collector_tokens_per_second(self):
+        t = make_tracker(cli_every=1000)
+        t.window_start_time = time.perf_counter() - 1.0  # pretend 1s elapsed
+        t.update(1, loss=1.0)
+        # one step's tokens over ~1s
+        assert t.cached_metrics["tokens_per_second"] == pytest.approx(
+            16 * 64, rel=0.2
+        )
+        assert t.cached_metrics["total_tokens"] == 16 * 64
+
+    def test_mfu_computed_when_flops_known(self):
+        t = make_tracker(
+            flops_per_token=1e9, peak_flops_per_chip=1e14, n_chips=1,
+            cli_every=1000,
+        )
+        t.window_start_time = time.perf_counter() - 1.0
+        t.update(1, loss=1.0)
+        assert "mfu" in t.cached_metrics
+        expected = t.cached_metrics["tokens_per_second_per_chip"] * 1e9 / 1e14
+        assert t.cached_metrics["mfu"] == pytest.approx(expected, rel=1e-6)
+
+    def test_distributed_reduce_fn_called(self):
+        calls = []
+
+        def fake_reduce(vals):
+            calls.append(vals)
+            return {k: v * 10 for k, v in vals.items()}
+
+        t = make_tracker(world_size=4, reduce_fn=fake_reduce)
+        t.update(1, loss=2.0, lr=1e-4)
+        # loss is distributed -> reduced; lr is not
+        assert calls == [{"loss": 2.0}]
+        assert t.buffers["loss"][-1] == 20.0
+        assert t.buffers["lr"][-1] == 1e-4
+
+    def test_unknown_metric_ignored(self):
+        t = make_tracker()
+        t.update(1, loss=1.0, bogus_metric=5.0)
+        assert "bogus_metric" not in t.buffers
+
+    def test_tensorboard_event_files_written(self, tmp_path):
+        t = make_tracker(tmp_path, tb_every=1)
+        for s in range(1, 4):
+            t.update(s, loss=float(s), lr=1e-4)
+        t.close()
+        events = glob.glob(os.path.join(str(tmp_path), "events.out.tfevents.*"))
+        assert events, "no TB event file written"
+        assert os.path.getsize(events[0]) > 0
+
+    def test_non_primary_writes_no_tb(self, tmp_path):
+        t = make_tracker(tmp_path, is_primary=False)
+        t.update(1, loss=1.0)
+        t.close()
+        assert t.writer is None
+
+    def test_epoch_lifecycle(self):
+        t = make_tracker()
+        t.start_epoch(3)
+        assert t.current_epoch == 3
+        assert t.window_tokens == 0
