@@ -80,7 +80,7 @@ def main() -> None:
     y = rng_np.integers(0, config.vocab_size, shape, dtype=np.int32)
 
     with mesh:
-        params, opt_state, _ = shard_params_and_opt_state(params, optimizer, mesh)
+        params, opt_state, _, _ = shard_params_and_opt_state(params, optimizer, mesh)
         step = make_train_step(config, optimizer)
         x, y = shard_batch((x, y), mesh)
         key = jax.random.PRNGKey(0)

@@ -69,9 +69,12 @@ def save_checkpoint(
     """Write one checkpoint; all processes participate (collective). Returns
     the checkpoint directory path."""
     path = os.path.join(os.path.abspath(save_dir), step_dir_name(step))
+    # force=True: re-saving the same step (final save landing on a periodic
+    # save's step, or retrying over a partial dir left by a crash) overwrites
+    # instead of raising — saves must be idempotent for resume to be robust.
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.join(path, "params"), params)
-        ckptr.save(os.path.join(path, "opt_state"), opt_state)
+        ckptr.save(os.path.join(path, "params"), params, force=True)
+        ckptr.save(os.path.join(path, "opt_state"), opt_state, force=True)
     # StandardCheckpointer.save is async-capable; the context-manager exit
     # above waits for completion, so meta.json lands only after the arrays.
     if jax.process_index() == 0:

@@ -1,0 +1,241 @@
+"""Offline tokenization pipeline: FineWeb -> uint16 ``.bin`` token shards.
+
+Script replacement for the reference's notebook
+(``/root/reference/data/fineweb_10BT_hugging_face.ipynb``), producing the
+identical on-disk format the dataloader consumes:
+
+* tiktoken GPT-2 BPE, ``encode_ordinary``, **EOT prepended** to every
+  document (notebook cell 6 — prepended, not appended),
+* token ids asserted to fit uint16 (GPT-2 vocab 50257 < 65536),
+* flat little-endian uint16 streams in 100M-token shards, documents split
+  across shard boundaries (cell 13),
+* filename convention ``{dataset}_{split}_{index:06d}.bin`` with shard 0
+  reserved for "val" and the rest "train" (cell 13),
+* a ``metadata.json`` index (cell 15).
+
+Runs host-side and hardware-independent; multiprocess tokenization via
+``Pool.imap`` with chunked submission, as the notebook does (cell 13).
+
+Usage::
+
+    python -m gpt_2_distributed_tpu.data.tokenize_fineweb \
+        --out_dir /data/fineweb_shards [--dataset HuggingFaceFW/fineweb] \
+        [--name sample-10BT] [--shard_size 100000000] [--max_tokens N]
+
+Also exposes ``tokenize_document`` / ``decode_tokens`` /
+``write_token_shard`` for tests and custom corpora.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from multiprocessing import Pool
+
+import numpy as np
+
+GPT2_EOT = 50256           # <|endoftext|>
+SHARD_SIZE = 100_000_000   # tokens per shard, notebook cell 13
+UINT16_MAX = 65535
+
+
+class ByteEncoder:
+    """Offline fallback codec: token id == utf-8 byte value (ids < 256, EOT
+    stays 50256). NOT GPT-2 BPE — for tests and air-gapped smoke runs only;
+    the real pipeline uses tiktoken, which needs its BPE vocabulary fetched
+    once."""
+
+    def encode_ordinary(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        return bytes(int(i) for i in ids if int(i) < 256).decode(
+            "utf-8", errors="replace"
+        )
+
+
+_encoders: dict[str, object] = {}
+
+
+def get_encoder(encoding: str = "gpt2"):
+    """"gpt2" -> tiktoken GPT-2 BPE (the reference tokenizer, notebook cell
+    6); "byte" -> offline debug codec."""
+    if encoding not in _encoders:
+        if encoding == "byte":
+            _encoders[encoding] = ByteEncoder()
+        else:
+            import tiktoken
+
+            _encoders[encoding] = tiktoken.get_encoding(encoding)
+    return _encoders[encoding]
+
+
+def tokenize_document(text: str, encoding: str = "gpt2") -> np.ndarray:
+    """One document -> uint16 token array with EOT *prepended*
+    (notebook cell 6)."""
+    ids = [GPT2_EOT]
+    ids.extend(get_encoder(encoding).encode_ordinary(text))
+    arr = np.asarray(ids, dtype=np.uint32)
+    if arr.max(initial=0) > UINT16_MAX:
+        raise ValueError("token id out of uint16 range")
+    return arr.astype(np.uint16)
+
+
+_worker_encoding = "gpt2"
+
+
+def _pool_init(encoding: str) -> None:
+    global _worker_encoding
+    _worker_encoding = encoding
+
+
+def _tokenize_row(row: dict) -> np.ndarray:
+    return tokenize_document(row["text"], _worker_encoding)
+
+
+def decode_tokens(tokens, encoding: str = "gpt2") -> str:
+    return get_encoder(encoding).decode([int(t) for t in tokens])
+
+
+def shard_filename(dataset: str, split: str, index: int) -> str:
+    """``{dataset}_{split}_{index:06d}.bin`` (notebook cell 13 get_filename)."""
+    return f"{dataset}_{split}_{index:06d}.bin"
+
+
+def write_token_shard(path: str, tokens: np.ndarray, chunk: int = 2**20) -> None:
+    """Chunked little-endian uint16 writer (notebook cell 8)."""
+    tokens = np.ascontiguousarray(tokens, dtype="<u2")
+    with open(path, "wb") as f:
+        for start in range(0, tokens.size, chunk):
+            tokens[start : start + chunk].tofile(f)
+
+
+class ShardWriter:
+    """Accumulates token streams and emits fixed-size shards; shard 0 is the
+    "val" split, all later shards "train" (notebook cell 13)."""
+
+    def __init__(
+        self,
+        out_dir: str,
+        dataset_name: str = "fineweb",
+        shard_size: int = SHARD_SIZE,
+    ) -> None:
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self.dataset_name = dataset_name
+        self.shard_size = int(shard_size)
+        self.buffer = np.empty(self.shard_size, dtype=np.uint16)
+        self.fill = 0
+        self.index = 0
+        self.shards: list[dict] = []
+        self.total_tokens = 0
+
+    def _split(self) -> str:
+        return "val" if self.index == 0 else "train"
+
+    def _flush(self, count: int) -> None:
+        name = shard_filename(self.dataset_name, self._split(), self.index)
+        path = os.path.join(self.out_dir, name)
+        write_token_shard(path, self.buffer[:count])
+        self.shards.append(
+            {"filename": name, "split": self._split(), "num_tokens": int(count)}
+        )
+        self.index += 1
+        self.fill = 0
+
+    def add(self, tokens: np.ndarray) -> None:
+        """Append one document's tokens, splitting across shard boundaries."""
+        self.total_tokens += int(tokens.size)
+        pos = 0
+        while pos < tokens.size:
+            take = min(tokens.size - pos, self.shard_size - self.fill)
+            self.buffer[self.fill : self.fill + take] = tokens[pos : pos + take]
+            self.fill += take
+            pos += take
+            if self.fill == self.shard_size:
+                self._flush(self.shard_size)
+
+    def close(self) -> None:
+        if self.fill:
+            self._flush(self.fill)
+        meta = {
+            "dataset": self.dataset_name,
+            "tokenizer": "tiktoken:gpt2",
+            "dtype": "<u2",
+            "eot_prepended": True,
+            "shard_size": self.shard_size,
+            "total_tokens": self.total_tokens,
+            "shards": self.shards,
+        }
+        with open(os.path.join(self.out_dir, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+
+
+def tokenize_corpus(
+    rows,
+    out_dir: str,
+    dataset_name: str = "fineweb",
+    shard_size: int = SHARD_SIZE,
+    num_procs: int | None = None,
+    max_tokens: int | None = None,
+    chunksize: int = 16,
+    encoding: str = "gpt2",
+) -> dict:
+    """Tokenize an iterable of ``{"text": ...}`` rows into shards. Returns the
+    metadata dict. Multiprocess pool with ``imap`` mirrors notebook cell 13."""
+    writer = ShardWriter(out_dir, dataset_name, shard_size)
+    if num_procs is None:
+        num_procs = max(1, (os.cpu_count() or 2) - 1)
+    if num_procs > 1:
+        with Pool(num_procs, initializer=_pool_init, initargs=(encoding,)) as pool:
+            for tokens in pool.imap(_tokenize_row, rows, chunksize=chunksize):
+                writer.add(tokens)
+                if max_tokens and writer.total_tokens >= max_tokens:
+                    break
+    else:
+        for row in rows:
+            writer.add(tokenize_document(row["text"], encoding))
+            if max_tokens and writer.total_tokens >= max_tokens:
+                break
+    writer.close()
+    meta_path = os.path.join(out_dir, "metadata.json")
+    with open(meta_path) as f:
+        return json.load(f)
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(prog="tokenize_fineweb")
+    p.add_argument("--out_dir", required=True)
+    p.add_argument("--dataset", default="HuggingFaceFW/fineweb")
+    p.add_argument("--name", default="sample-10BT")
+    p.add_argument("--dataset_name", default="fineweb", help="output filename prefix")
+    p.add_argument("--shard_size", type=int, default=SHARD_SIZE)
+    p.add_argument("--num_procs", type=int, default=None)
+    p.add_argument("--max_tokens", type=int, default=None)
+    p.add_argument(
+        "--encoding", default="gpt2", choices=["gpt2", "byte"],
+        help="'byte' is an offline debug codec, not GPT-2 BPE",
+    )
+    args = p.parse_args(argv)
+
+    from datasets import load_dataset  # deferred: needs network/cache
+
+    rows = load_dataset(args.dataset, name=args.name, split="train", streaming=True)
+    meta = tokenize_corpus(
+        rows,
+        args.out_dir,
+        dataset_name=args.dataset_name,
+        shard_size=args.shard_size,
+        num_procs=args.num_procs,
+        max_tokens=args.max_tokens,
+        encoding=args.encoding,
+    )
+    print(
+        f"wrote {len(meta['shards'])} shards, {meta['total_tokens']:,} tokens "
+        f"to {args.out_dir}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -188,15 +188,14 @@ class StatsTracker:
         return d.reduction.reduce(list(buf))
 
     def _write_tensorboard(self, step: int) -> None:
-        """Window-reduced buffered metrics + raw cached metrics
-        (``/root/reference/stats_tracker.py:563-594``)."""
+        """Every metric's window collapsed by its declared reduction
+        (``/root/reference/stats_tracker.py:563-594``) — collector metrics go
+        through the same windows as pushed ones, so e.g.
+        ``device_peak_alloc_gb``'s MAX really is a windowed max."""
         for d in self.registry.all():
-            if d.name in self.cached_metrics:
-                self.writer.add_scalar(d.tb_tag, self.cached_metrics[d.name], step)
-            else:
-                v = self._window_value(d)
-                if v is not None:
-                    self.writer.add_scalar(d.tb_tag, v, step)
+            v = self._window_value(d)
+            if v is not None:
+                self.writer.add_scalar(d.tb_tag, v, step)
         now = time.perf_counter()
         if now - self._last_flush >= TB_FLUSH_INTERVAL_S:
             self.writer.flush()
@@ -209,10 +208,7 @@ class StatsTracker:
         for d in self.registry.all():
             if d.cli_format is None:
                 continue
-            if d.name in self.cached_metrics:
-                v: float | None = self.cached_metrics[d.name]
-            else:
-                v = self._window_value(d)
+            v = self._window_value(d)
             if v is None:
                 continue
             text = d.cli_format.format(name=d.name, value=v)

@@ -105,20 +105,22 @@ def opt_state_shardings(
 
 def shard_params_and_opt_state(
     params: Any, optimizer: optax.GradientTransformation, mesh: Mesh
-) -> tuple[Any, Any, Any]:
+) -> tuple[Any, Any, Any, Any]:
     """Place params on the mesh per the param rule and build the optimizer
     state sharded like its params. The moment shardings are enforced with
     explicit ``out_shardings`` — jit does NOT propagate input shardings to
     outputs reliably (XLA may replicate them), which would silently give up
     ZeRO and triple per-device optimizer memory.
 
-    Returns ``(sharded_params, sharded_opt_state, param_shardings)``.
+    Returns ``(sharded_params, sharded_opt_state, param_shardings,
+    opt_shardings)`` — both sharding trees, so callers (e.g. checkpoint
+    restore) never recompute them.
     """
     shardings = _to_named(param_pspecs(params, mesh), mesh)
     params = jax.device_put(params, shardings)
     opt_shardings = opt_state_shardings(params, optimizer, mesh)
     opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
-    return params, opt_state, shardings
+    return params, opt_state, shardings, opt_shardings
 
 
 def shard_batch(batch: Any, mesh: Mesh, leading_accum_axis: bool = True) -> Any:

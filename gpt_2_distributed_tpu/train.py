@@ -130,7 +130,6 @@ def main(argv: list[str] | None = None) -> None:
     from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
     from gpt_2_distributed_tpu.models import gpt2
     from gpt_2_distributed_tpu.parallel.sharding import (
-        opt_state_shardings,
         shard_batch,
         shard_params_and_opt_state,
     )
@@ -181,8 +180,8 @@ def main(argv: list[str] | None = None) -> None:
     params = gpt2.init_params(config, seed=args.seed)
 
     with mesh:
-        params, opt_state, param_shardings = shard_params_and_opt_state(
-            params, optimizer, mesh
+        params, opt_state, param_shardings, opt_shardings = (
+            shard_params_and_opt_state(params, optimizer, mesh)
         )
         train_step = make_train_step(config, optimizer)
 
@@ -192,13 +191,19 @@ def main(argv: list[str] | None = None) -> None:
             latest = ckpt.latest_checkpoint(args.save_dir)
             if latest is not None:
                 params, opt_state, meta = ckpt.restore_checkpoint(
-                    latest, params, opt_state, param_shardings,
-                    opt_state_shardings(params, optimizer, mesh),
+                    latest, params, opt_state, param_shardings, opt_shardings
                 )
                 start_epoch = meta.epoch
                 skip_steps = meta.batches_in_epoch
                 global_step = meta.step
                 total_tokens = meta.total_tokens
+                if meta.rng_seed != args.seed and is_primary():
+                    print(
+                        f"warning: --seed {args.seed} differs from the "
+                        f"checkpoint's seed {meta.rng_seed}; using the "
+                        f"checkpoint's so dropout streams resume exactly"
+                    )
+                args.seed = meta.rng_seed
                 if is_primary():
                     print(
                         f"resumed from {latest}: step {global_step}, epoch "
@@ -250,6 +255,7 @@ def main(argv: list[str] | None = None) -> None:
             )
 
         done = False
+        last_saved_step = -1
         epoch, step_in_epoch = start_epoch, skip_steps
         for epoch in range(start_epoch, args.epochs):
             dataset.set_epoch(epoch)
@@ -282,6 +288,7 @@ def main(argv: list[str] | None = None) -> None:
 
                 if args.save_dir and args.save_every and global_step % args.save_every == 0:
                     flush_pending()
+                    last_saved_step = global_step
                     ckpt.save_checkpoint(
                         args.save_dir, global_step, params, opt_state,
                         ckpt.CheckpointMeta(
@@ -302,7 +309,7 @@ def main(argv: list[str] | None = None) -> None:
         flush_pending()
         if args.profile and args.log_dir:
             jax.profiler.stop_trace()
-        if args.save_dir:
+        if args.save_dir and global_step != last_saved_step:
             ckpt.save_checkpoint(
                 args.save_dir, global_step, params, opt_state,
                 ckpt.CheckpointMeta(

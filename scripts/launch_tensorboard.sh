@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Serve TensorBoard for the training runs — parity with the reference's
+# scripts/launch_tensorboard.sh (port 6006, SSH-tunnel recipe).
+#
+# View from a local machine with:
+#   ssh -L 6006:localhost:6006 <user>@<tpu-vm-host>
+# then open http://localhost:6006
+#
+# The same instance also serves jax.profiler traces written by
+# `train.py --profile` (under <log_dir>/profile).
+set -euo pipefail
+
+LOG_DIR="${1:-runs}"
+PORT="${2:-6006}"
+
+tensorboard --logdir "$LOG_DIR" --port "$PORT" --bind_all

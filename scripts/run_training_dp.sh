@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Data-parallel training over all local TPU devices — the DDP-equivalent of
+# the reference's run_training_local_single_gpu_ddp.sh. No torchrun needed:
+# one process drives every local chip; GSPMD inserts the gradient psum that
+# DDP gets from NCCL backward hooks.
+# Usage: ./scripts/run_training_dp.sh DATA_DIR [extra train.py flags...]
+set -euo pipefail
+
+DATA_DIR="${1:?usage: $0 DATA_DIR [flags...]}"
+shift || true
+
+python -m gpt_2_distributed_tpu.train \
+    --data_dir "$DATA_DIR" \
+    --training_mode dp \
+    --batch 4 \
+    --seq_len 1024 \
+    --grad_accum_steps 4 \
+    --lr 1e-4 \
+    --save_every 1000 \
+    --save_dir checkpoints \
+    --log_dir runs \
+    "$@"

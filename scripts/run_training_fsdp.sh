@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# FSDP-equivalent training over all local TPU devices — parameter + optimizer
+# state sharded (ZeRO-3 semantics) via GSPMD PartitionSpecs, matching the
+# reference's run_training_local_single_gpu_fsdp.sh (torch FSDP FULL_SHARD).
+# Usage: ./scripts/run_training_fsdp.sh DATA_DIR [extra train.py flags...]
+set -euo pipefail
+
+DATA_DIR="${1:?usage: $0 DATA_DIR [flags...]}"
+shift || true
+
+python -m gpt_2_distributed_tpu.train \
+    --data_dir "$DATA_DIR" \
+    --training_mode fsdp \
+    --batch 4 \
+    --seq_len 1024 \
+    --grad_accum_steps 4 \
+    --lr 1e-4 \
+    --save_every 1000 \
+    --save_dir checkpoints \
+    --log_dir runs \
+    "$@"

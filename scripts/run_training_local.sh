@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Single-device training — the TPU equivalent of the reference's
+# run_training_local_single_gpu.sh (plain python, mode "local").
+# Usage: ./scripts/run_training_local.sh DATA_DIR [extra train.py flags...]
+set -euo pipefail
+
+DATA_DIR="${1:?usage: $0 DATA_DIR [flags...]}"
+shift || true
+
+python -m gpt_2_distributed_tpu.train \
+    --data_dir "$DATA_DIR" \
+    --training_mode local \
+    --batch 4 \
+    --seq_len 1024 \
+    --grad_accum_steps 4 \
+    --lr 1e-4 \
+    --save_every 1000 \
+    --save_dir checkpoints \
+    --log_dir runs \
+    "$@"

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod training — the equivalent of the reference's
+# run_training_distributed_fsdp_main.sh / _worker.sh torchrun pair
+# (2 nodes x 4 GPUs). One script serves every host: on Cloud TPU VMs
+# jax.distributed.initialize() auto-detects the coordinator and process
+# count, so simply run this on all workers, e.g.
+#
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
+#       --command="cd gpt2-tpu && ./scripts/run_training_tpu_pod.sh /data/shards"
+#
+# Off-cloud (or to override auto-detection) export the torchrun-style env the
+# reference uses (run_training_distributed_fsdp_main.sh:15-20):
+#   MASTER_ADDR=<host0>  MASTER_PORT=12355  WORLD_SIZE=<n_hosts>  RANK=<host_id>
+#
+# Each host feeds the slice of the global batch its local chips own; params
+# shard over ICI within the slice (fsdp axis), gradient reduction rides
+# data-parallel collectives.
+# Usage: ./scripts/run_training_tpu_pod.sh DATA_DIR [extra train.py flags...]
+set -euo pipefail
+
+DATA_DIR="${1:?usage: $0 DATA_DIR [flags...]}"
+shift || true
+
+python -m gpt_2_distributed_tpu.train \
+    --data_dir "$DATA_DIR" \
+    --training_mode fsdp \
+    --batch 4 \
+    --seq_len 1024 \
+    --grad_accum_steps 4 \
+    --lr 1e-4 \
+    --save_every 1000 \
+    --save_dir checkpoints \
+    --log_dir runs \
+    "$@"

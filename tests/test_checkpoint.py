@@ -80,7 +80,7 @@ def test_sharded_restore_onto_mesh(tmp_path, tiny_config):
     mesh = create_mesh(MeshSpec(1, 8))
     with mesh:
         params = gpt2.init_params(tiny_config)
-        params, opt_state, shardings = shard_params_and_opt_state(
+        params, opt_state, shardings, opt_shardings = shard_params_and_opt_state(
             params, optimizer, mesh
         )
         meta = ckpt.CheckpointMeta(step=1, epoch=0, batches_in_epoch=1, rng_seed=0)

@@ -102,7 +102,7 @@ def test_fsdp_params_actually_sharded(tiny_config):
     optimizer = make_optimizer(1e-3)
     mesh = create_mesh(MeshSpec(1, 8))
     with mesh:
-        params, opt_state, _ = shard_params_and_opt_state(params, optimizer, mesh)
+        params, opt_state, _, _ = shard_params_and_opt_state(params, optimizer, mesh)
     w = params["block"]["mlp_fc_w"]  # [L, C, 4C] = [2, 32, 128]
     # Each device holds 1/8 of the leaf.
     shard_shapes = {s.data.shape for s in w.addressable_shards}
@@ -143,7 +143,7 @@ def test_mode_equivalence(tiny_config, spec):
         mesh = create_mesh(mesh_spec)
         losses = []
         with mesh:
-            params, opt_state, _ = shard_params_and_opt_state(
+            params, opt_state, _, _ = shard_params_and_opt_state(
                 params, optimizer, mesh
             )
             step = make_train_step(tiny_config, optimizer, donate=False)
