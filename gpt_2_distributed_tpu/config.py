@@ -40,6 +40,11 @@ class GPT2Config:
     # --- TPU-build extensions (not in the reference) ---
     remat: bool = False            # activation checkpointing of each block (lax.scan body)
     scan_layers: bool = True       # stacked-layer params + lax.scan over blocks
+    # Attention kernel: "dense" = XLA O(T^2) parity baseline (reference
+    # semantics, model.py:137-151); "flash" = Pallas fused kernel (VMEM
+    # score stripes, in-kernel dropout); "auto" = flash on TPU when the
+    # sequence length allows it, dense otherwise.
+    attention_impl: str = "auto"
 
     def __post_init__(self) -> None:
         if self.n_embd % self.n_head != 0:

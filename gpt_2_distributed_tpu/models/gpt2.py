@@ -39,8 +39,9 @@ import jax.numpy as jnp
 
 from gpt_2_distributed_tpu.config import GPT2Config
 from gpt_2_distributed_tpu.ops.activations import gelu_tanh
-from gpt_2_distributed_tpu.ops.attention import causal_attention
+from gpt_2_distributed_tpu.ops.attention import causal_attention, select_attention_impl
 from gpt_2_distributed_tpu.ops.layers import dropout, layer_norm
+from gpt_2_distributed_tpu.ops.losses import blocked_cross_entropy
 
 Params = dict[str, Any]
 
@@ -120,7 +121,8 @@ def _block(
     q = q.reshape(b, t, h, d).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, h, d).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, h, d).transpose(0, 2, 1, 3)
-    o = causal_attention(
+    attn_fn = select_attention_impl(config.attention_impl, t)
+    o = attn_fn(
         q, k, v,
         dropout_rate=config.attn_dropout, rng=r_attn, deterministic=deterministic,
     )
@@ -149,8 +151,15 @@ def forward(
     rng: jax.Array | None = None,
     deterministic: bool = True,
     compute_dtype: jnp.dtype = jnp.bfloat16,
-) -> tuple[jnp.ndarray, jnp.ndarray | None]:
-    """Forward pass. Returns ``(logits [B,T,V] fp32, loss scalar fp32 | None)``.
+    return_logits: bool = False,
+) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
+    """Forward pass. Returns ``(logits [B,T,V] fp32 | None, loss fp32 | None)``.
+
+    When ``labels`` are given and ``return_logits`` is False (the training
+    path), the loss comes from the blocked cross-entropy — full ``[B,T,V]``
+    logits are never materialized (``ops/losses.py``), and ``None`` is
+    returned in their place. Inference (``labels=None``) always returns
+    logits.
 
     Sequence-length guard matches the reference's hard error beyond
     n_positions (``/root/reference/model.py:291-292``) — here it is a trace-time
@@ -201,12 +210,19 @@ def forward(
             x = blk(config, x, bp, lr, deterministic)
 
     x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
+
+    wte = params["wte"].astype(compute_dtype)
+    if labels is not None and not return_logits:
+        # Training path: blocked CE over the tied head — no [B,T,V] logits.
+        loss = blocked_cross_entropy(
+            x.reshape(-1, config.n_embd), wte, labels.reshape(-1)
+        )
+        return None, loss
+
     # Tied lm_head: logits = x @ wte^T, fp32 accumulation out of the bf16 matmul.
     logits = jnp.einsum(
-        "btc,vc->btv", x, params["wte"].astype(compute_dtype),
-        preferred_element_type=jnp.float32,
+        "btc,vc->btv", x, wte, preferred_element_type=jnp.float32,
     )
-
     loss = None
     if labels is not None:
         loss = cross_entropy(logits, labels)

@@ -53,3 +53,26 @@ def causal_attention(
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), jnp.zeros_like(probs))
     probs = probs.astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def select_attention_impl(impl: str, seq_len: int):
+    """Resolve an attention implementation name to a callable with the
+    ``causal_attention`` signature. Called at trace time (static shapes)."""
+    from gpt_2_distributed_tpu.ops.flash_attention import (
+        DEFAULT_BLOCK_Q,
+        flash_attention,
+    )
+
+    if impl == "dense":
+        return causal_attention
+    if impl == "flash":
+        return flash_attention
+    if impl == "auto":
+        import jax
+
+        flash_ok = (
+            seq_len % DEFAULT_BLOCK_Q == 0
+            and jax.devices()[0].platform == "tpu"
+        )
+        return flash_attention if flash_ok else causal_attention
+    raise ValueError(f"unknown attention_impl {impl!r}; expected dense|flash|auto")

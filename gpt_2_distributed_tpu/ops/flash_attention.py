@@ -1,0 +1,307 @@
+"""Pallas TPU flash attention: fused causal attention with in-kernel dropout.
+
+The reference materializes dense ``[B, H, T, T]`` score/prob tensors in HBM
+(``/root/reference/model.py:137-151``) — at seq 1024 that is the dominant HBM
+traffic and the activation-memory cap on micro-batch size (SURVEY.md §5.7).
+This kernel keeps the score block resident in VMEM: per ``(batch, head,
+q-block)`` grid step it computes a ``[block_q, T]`` score stripe against the
+full K/V (which fit comfortably in VMEM at GPT-2 scales: T=1024, D=64 ->
+256 KB), applies the causal mask and a row softmax, optional probability
+dropout from the TPU hardware PRNG, and contracts with V — nothing O(T^2)
+ever touches HBM.
+
+Backward is a custom VJP (one Pallas kernel): per q-block it regenerates the
+probabilities from the saved log-sum-exp (the flash-attention trick — no
+stored probs), regenerates the *identical* dropout bits by reseeding the PRNG
+with the same (batch, head, q-block)-derived seed, and produces dq per block
+plus dk/dv accumulated across q-blocks into VMEM-resident outputs.
+
+Numerics vs. the dense path: the dense reference masks scores to -1e4
+(``model.py:144``); here masked lanes get -1e30 before the row max — for
+causal masking the two are identical in fp32 (masked terms underflow to 0
+either way; every row has at least its diagonal unmasked). Softmax runs in
+fp32; inputs/outputs are the model's compute dtype (bf16).
+
+Dropout semantics match ``torch.nn.functional.dropout`` on the normalized
+probabilities: ``o = (mask * P / keep_prob) @ v``. In-kernel we apply the mask
+to the unnormalized exponentials and divide by the *undropped* row sum, which
+is algebraically the same. The dropout RNG stream is the TPU PRNG, not
+``jax.random`` — masks differ from the dense implementation run-to-run, which
+is within the reference's contract (dropout is stochastic; determinism holds
+per seed per implementation).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # causal mask fill for fp32 row-max stability (see docstring)
+DEFAULT_BLOCK_Q = 128
+
+
+def _dropout_bits(seed, b, h, qi, block_q, t):
+    """Counter-based uint32 random bits for one [block_q, T] stripe.
+
+    A murmur3-finalizer hash of the absolute (batch, head, row, col) position
+    mixed with the seed — stateless, so the backward kernel regenerates the
+    forward's exact mask by construction, and the same bits come out on TPU
+    and in CPU interpret mode (pltpu's hardware PRNG has no CPU lowering).
+    """
+    # Everything must be uint32 BEFORE any arithmetic: a stray int32 operand
+    # promotes the whole expression and turns >> into an arithmetic shift on
+    # negative values, silently changing the stream (and making traced program
+    # ids disagree with Python ints).
+    b = jnp.asarray(b).astype(jnp.uint32)
+    h = jnp.asarray(h).astype(jnp.uint32)
+    qi = jnp.asarray(qi).astype(jnp.uint32)
+    row = qi * jnp.uint32(block_q) + jax.lax.broadcasted_iota(
+        jnp.uint32, (block_q, t), 0
+    )
+    col = jax.lax.broadcasted_iota(jnp.uint32, (block_q, t), 1)
+    x = (
+        seed.astype(jnp.uint32)
+        ^ (b * jnp.uint32(0x9E3779B1))
+        ^ (h * jnp.uint32(0x85EBCA77))
+    )
+    x = x ^ (row * jnp.uint32(0xC2B2AE3D)) ^ (col * jnp.uint32(0x27D4EB2F))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _fwd_kernel(
+    seed_ref,  # scalar prefetch: [1] int32
+    q_ref,     # [1, 1, bq, D]
+    k_ref,     # [1, 1, T, D]
+    v_ref,     # [1, 1, T, D]
+    o_ref,     # [1, 1, bq, D]
+    lse_ref,   # [1, 1, bq, 1]
+    *,
+    block_q: int,
+    dropout_rate: float,
+):
+    b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    t = k_ref.shape[2]
+    d = q_ref.shape[3]
+    scale = 1.0 / (d ** 0.5)
+
+    q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
+    k = k_ref[0, 0].astype(jnp.float32)          # [T, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                     # [bq, T]
+
+    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 1)
+    s = jnp.where(col <= row, s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
+    p = jnp.exp(s - m)                            # [bq, T]
+    l = jnp.sum(p, axis=-1, keepdims=True)        # [bq, 1]
+    lse_ref[0, 0] = m + jnp.log(l)     # [bq, 1]
+
+    if dropout_rate > 0.0:
+        bits = _dropout_bits(seed_ref[0], b, h, qi, block_q, t)
+        threshold = jnp.uint32(int(dropout_rate * (2**32)))
+        keep = bits >= threshold
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+
+    v = v_ref[0, 0].astype(jnp.float32)           # [T, D]
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / l                                         # [bq, D]
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _bwd_kernel(
+    seed_ref,   # scalar prefetch: [1] int32
+    q_ref,      # [1, 1, bq, D]
+    k_ref,      # [1, 1, T, D]
+    v_ref,      # [1, 1, T, D]
+    do_ref,     # [1, 1, bq, D]
+    lse_ref,    # [1, 1, bq, 1]
+    delta_ref,  # [1, 1, bq, 1]
+    dq_ref,     # [1, 1, bq, D]  per-block
+    dk_ref,     # [1, 1, T, D]   accumulated across q-blocks (fp32)
+    dv_ref,     # [1, 1, T, D]   accumulated across q-blocks (fp32)
+    *,
+    block_q: int,
+    dropout_rate: float,
+):
+    b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    t = k_ref.shape[2]
+    d = q_ref.shape[3]
+    scale = 1.0 / (d ** 0.5)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)          # [bq, D]
+    lse = lse_ref[0, 0]                            # [bq, 1]
+    delta = delta_ref[0, 0]                        # [bq, 1]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                      # [bq, T]
+    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 1)
+    s = jnp.where(col <= row, s, NEG_INF)
+    p = jnp.exp(s - lse)                           # normalized probs P [bq, T]
+
+    # dPd = do @ v^T; dP = mask*dPd/kp; Pd = mask*P/kp
+    dpd = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                              # [bq, T]
+    if dropout_rate > 0.0:
+        bits = _dropout_bits(seed_ref[0], b, h, qi, block_q, t)
+        threshold = jnp.uint32(int(dropout_rate * (2**32)))
+        keep = bits >= threshold
+        kp = 1.0 - dropout_rate
+        pd = jnp.where(keep, p / kp, 0.0)          # dropped+rescaled probs
+        dp = jnp.where(keep, dpd / kp, 0.0)        # dL/dP
+    else:
+        pd = p
+        dp = dpd
+
+    ds = p * (dp - delta)                          # [bq, T] softmax bwd
+    dq_ref[0, 0] = (
+        jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+    ).astype(dq_ref.dtype)
+    dk_ref[0, 0] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                      # [T, D]
+    dv_ref[0, 0] += jax.lax.dot_general(
+        pd, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )                                              # [T, D]
+
+
+@functools.lru_cache(maxsize=None)
+def _build(dropout_rate: float, block_q: int, interpret: bool):
+    """Build the custom-VJP flash attention for one static config."""
+
+    def fwd_call(q, k, v, seed):
+        batch, heads, t, d = q.shape
+        nq = t // block_q
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, heads, nq),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, *_: (b, h, i, 0)),
+            ],
+        )
+        o, lse = pl.pallas_call(
+            functools.partial(
+                _fwd_kernel, block_q=block_q, dropout_rate=dropout_rate
+            ),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct((batch, heads, t, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(seed, q, k, v)
+        return o, lse
+
+    @jax.custom_vjp
+    def attn(q, k, v, seed):
+        o, _ = fwd_call(q, k, v, seed)
+        return o
+
+    def attn_fwd(q, k, v, seed):
+        o, lse = fwd_call(q, k, v, seed)
+        return o, (q, k, v, seed, o, lse)
+
+    def attn_bwd(res, do):
+        q, k, v, seed, o, lse = res
+        batch, heads, t, d = q.shape
+        nq = t // block_q
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, heads, nq),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, *_: (b, h, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
+            ],
+        )
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_kernel, block_q=block_q, dropout_rate=dropout_rate
+            ),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct(k.shape, jnp.float32),
+                jax.ShapeDtypeStruct(v.shape, jnp.float32),
+            ],
+            interpret=interpret,
+        )(seed, q, k, v, do, lse, delta)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype), None
+
+    attn.defvjp(attn_fwd, attn_bwd)
+    return attn
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, H, T, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    dropout_rate: float = 0.0,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Causal flash attention. Drop-in for ``ops.attention.causal_attention``.
+
+    Requires ``T % block_q == 0`` (the driver picks block_q <= T). ``rng``
+    seeds the in-kernel dropout PRNG when training.
+    """
+    t = q.shape[2]
+    block_q = min(block_q, t)
+    if t % block_q:
+        raise ValueError(f"flash attention needs T % block_q == 0, got T={t}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    rate = float(dropout_rate) if (not deterministic and rng is not None) else 0.0
+    if rate > 0.0:
+        # Fold the jax PRNG key down to one int32 kernel seed.
+        seed = jax.random.randint(rng, (1,), 0, jnp.iinfo(jnp.int32).max, jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    return _build(rate, block_q, interpret)(q, k, v, seed)

@@ -1,0 +1,130 @@
+"""Blocked (logit-free) cross-entropy over the tied lm_head.
+
+The reference computes full ``[B*T, V]`` fp32 logits and feeds them to
+``F.cross_entropy`` (``/root/reference/model.py:351-359``). At GPT-2 vocab
+50257 that tensor is the single largest activation in training — 3.3 GB fp32
+at micro-batch 16 / seq 1024, plus log-softmax residuals for backward — and
+it caps the micro-batch long before the transformer stack does.
+
+``blocked_cross_entropy`` contracts the final hidden states against the tied
+embedding in row chunks under ``lax.scan``: each chunk's logits live only as
+a ``[rows, V]`` transient inside the scan step, reduced immediately to the
+log-sum-exp and the label logit. Backward is a custom VJP that recomputes
+each chunk's logits from the saved per-row LSE (the same residual trick as
+flash attention) and accumulates ``d_wte`` in fp32 — HBM cost drops from
+O(B*T*V) to O(rows*V).
+
+Numerics: identical to the dense path — fp32 logits (bf16 matmul inputs with
+fp32 accumulation via ``preferred_element_type``), fp32 log-softmax,
+``ignore_index=-100`` token-mean (``model.py:357-359``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+DEFAULT_BLOCK_ROWS = 1024
+
+
+def _chunk_stats(x_chunk, wte, labels_chunk):
+    """One chunk: (lse [R], label_logit [R]) from a transient [R, V] logits."""
+    logits = jax.lax.dot_general(
+        x_chunk, wte, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [R, V]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    safe = jnp.clip(labels_chunk, 0, wte.shape[0] - 1)
+    label_logit = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+    return lse, label_logit
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def blocked_cross_entropy(x, wte, labels, block_rows: int = DEFAULT_BLOCK_ROWS):
+    """Token-mean CE of ``x @ wte^T`` against ``labels`` without materializing
+    the full logits.
+
+    x: [N, C] final hidden states (compute dtype); wte: [V, C] tied embedding
+    (compute dtype); labels: [N] int, ``IGNORE_INDEX`` masked out.
+    """
+    loss, _ = _ce_fwd_impl(x, wte, labels, block_rows)
+    return loss
+
+
+def _pad_rows(x, labels, block_rows):
+    n = x.shape[0]
+    padded = (n + block_rows - 1) // block_rows * block_rows
+    if padded != n:
+        x = jnp.pad(x, ((0, padded - n), (0, 0)))
+        labels = jnp.pad(labels, (0, padded - n), constant_values=IGNORE_INDEX)
+    return x, labels, padded
+
+
+def _ce_fwd_impl(x, wte, labels, block_rows):
+    n = x.shape[0]
+    xp, lp, padded = _pad_rows(x, labels, block_rows)
+    xc = xp.reshape(padded // block_rows, block_rows, -1)
+    lc = lp.reshape(padded // block_rows, block_rows)
+
+    def body(_, chunk):
+        xch, lch = chunk
+        lse, label_logit = _chunk_stats(xch, wte, lch)
+        return None, (lse, label_logit)
+
+    _, (lse, label_logit) = jax.lax.scan(body, None, (xc, lc))
+    lse, label_logit = lse.reshape(-1)[:n], label_logit.reshape(-1)[:n]
+    valid = labels != IGNORE_INDEX
+    count = jnp.maximum(valid.sum(), 1)
+    loss = jnp.where(valid, lse - label_logit, 0.0).sum() / count
+    return loss, (lse, count)
+
+
+def _ce_fwd(x, wte, labels, block_rows):
+    loss, (lse, count) = _ce_fwd_impl(x, wte, labels, block_rows)
+    return loss, (x, wte, labels, lse, count)
+
+
+def _ce_bwd(block_rows, res, g):
+    x, wte, labels, lse, count = res
+    n, c = x.shape
+    xp, lp, padded = _pad_rows(x, labels, block_rows)
+    lsep = jnp.pad(lse, (0, padded - n))
+    xc = xp.reshape(padded // block_rows, block_rows, c)
+    lc = lp.reshape(padded // block_rows, block_rows)
+    lsec = lsep.reshape(padded // block_rows, block_rows)
+    scale = (g / count).astype(jnp.float32)
+
+    def body(dwte_acc, chunk):
+        xch, lch, lsech = chunk
+        logits = jax.lax.dot_general(
+            xch, wte, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R, V]
+        p = jnp.exp(logits - lsech[:, None])
+        valid = lch != IGNORE_INDEX
+        safe = jnp.clip(lch, 0, wte.shape[0] - 1)
+        onehot = jax.nn.one_hot(safe, wte.shape[0], dtype=jnp.float32)
+        grad_logits = jnp.where(valid[:, None], (p - onehot) * scale, 0.0)
+        dx = jax.lax.dot_general(
+            grad_logits, wte.astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R, C]
+        dwte_acc = dwte_acc + jax.lax.dot_general(
+            grad_logits, xch.astype(jnp.float32),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [V, C]
+        return dwte_acc, dx
+
+    dwte, dxc = jax.lax.scan(
+        body, jnp.zeros(wte.shape, jnp.float32), (xc, lc, lsec)
+    )
+    dx = dxc.reshape(padded, c)[:n].astype(x.dtype)
+    return dx, dwte.astype(wte.dtype), None
+
+
+blocked_cross_entropy.defvjp(_ce_fwd, _ce_bwd)

@@ -25,7 +25,7 @@ def test_forward_shapes_and_loss(tiny_config, rng_np):
     params = gpt2.init_params(tiny_config)
     x, y = _batch(tiny_config, rng_np, b=3, t=16)
     logits, loss = gpt2.forward(params, tiny_config, x, labels=y,
-                                compute_dtype=jnp.float32)
+                                compute_dtype=jnp.float32, return_logits=True)
     assert logits.shape == (3, 16, tiny_config.vocab_size)
     assert logits.dtype == jnp.float32
     assert loss.shape == () and jnp.isfinite(loss)
@@ -75,8 +75,10 @@ def test_scan_and_loop_paths_agree(tiny_config, rng_np):
     x, y = _batch(tiny_config, rng_np, b=2, t=32)
     cfg_scan = tiny_config.replace(scan_layers=True)
     cfg_loop = tiny_config.replace(scan_layers=False)
-    l1, loss1 = gpt2.forward(params, cfg_scan, x, labels=y, compute_dtype=jnp.float32)
-    l2, loss2 = gpt2.forward(params, cfg_loop, x, labels=y, compute_dtype=jnp.float32)
+    l1, loss1 = gpt2.forward(params, cfg_scan, x, labels=y,
+                             compute_dtype=jnp.float32, return_logits=True)
+    l2, loss2 = gpt2.forward(params, cfg_loop, x, labels=y,
+                             compute_dtype=jnp.float32, return_logits=True)
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
     np.testing.assert_allclose(float(loss1), float(loss2), atol=1e-6)
 
@@ -98,7 +100,8 @@ def test_ignore_index_masking(tiny_config, rng_np):
     _, loss_full = gpt2.forward(params, tiny_config, x, labels=y,
                                 compute_dtype=jnp.float32)
     logits, loss_masked = gpt2.forward(params, tiny_config, x, labels=y_masked,
-                                       compute_dtype=jnp.float32)
+                                       compute_dtype=jnp.float32,
+                                       return_logits=True)
     # Manual CE over the unmasked half must equal the masked loss.
     lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     manual = -np.mean(
