@@ -32,7 +32,12 @@ def main() -> None:
     p.add_argument("--grad_accum_steps", type=int, default=1)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
-    p.add_argument("--remat", action="store_true", help="activation checkpointing")
+    p.add_argument(
+        "--remat", nargs="?", const="block", default=False,
+        choices=["block", "mlp"],
+        help="activation checkpointing ('block' = whole block, 'mlp' = MLP "
+        "sublayer only; bare flag means 'block')",
+    )
     args = p.parse_args()
     args.steps = max(1, args.steps)
     args.warmup = max(1, args.warmup)  # first call doubles as the compile step

@@ -38,7 +38,13 @@ class GPT2Config:
     layer_norm_eps: float = 1e-5
     initializer_range: float = 0.02  # N(0, 0.02) for Linear/Embedding weights
     # --- TPU-build extensions (not in the reference) ---
-    remat: bool = False            # activation checkpointing of each block (lax.scan body)
+    # Activation checkpointing: False = save everything; True/"block" = remat
+    # the whole block (lax.scan body) — lowest memory, one full extra forward
+    # in backward, needed for the 1.5B config; "mlp" = remat only the MLP
+    # sublayer — saves the flash-attention forward from running twice while
+    # still dropping the 4C-wide MLP activations (the memory bulk). "mlp" is
+    # the throughput sweet spot for models that fit.
+    remat: bool | str = False
     scan_layers: bool = True       # stacked-layer params + lax.scan over blocks
     # Attention kernel: "dense" = XLA O(T^2) parity baseline (reference
     # semantics, model.py:137-151); "flash" = Pallas fused kernel (VMEM

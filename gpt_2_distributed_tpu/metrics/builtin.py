@@ -58,6 +58,15 @@ METRIC_REGISTRY.metric(
     "batch", reduction=ReductionStrategy.CURRENT, cli_format="batch: {value:.0f}",
 )(lambda v: float(int(v)))
 
+# Periodic validation loss over the held-out shard (shard 0 is reserved as
+# "val" by the tokenizer pipeline, notebook cell 13 convention). The reference
+# reserves the split but never consumes it; the TPU build's --eval_every wires
+# it up (VERDICT round-1 gap #4).
+METRIC_REGISTRY.metric(
+    "eval_loss", reduction=ReductionStrategy.CURRENT, distributed=True,
+    tb_prefix="eval/", cli_format="eval_loss: {value:.4f}",
+)(float)
+
 
 # --- freq-1 performance collector ------------------------------------------
 

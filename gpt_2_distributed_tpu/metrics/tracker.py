@@ -124,9 +124,15 @@ class StatsTracker:
         self.window_start_time = time.perf_counter()
         self.window_tokens = 0
 
-    def update(self, step: int, **metrics: Any) -> None:
+    def update(self, step: int, count_tokens: bool = True, **metrics: Any) -> None:
         """Record one optimizer step's metrics
-        (``/root/reference/stats_tracker.py:501-561``)."""
+        (``/root/reference/stats_tracker.py:501-561``).
+
+        ``count_tokens=False`` marks an out-of-band update (e.g. a periodic
+        eval result) for a step whose training update was already recorded —
+        without it a second call would re-add ``tokens_per_step`` and inflate
+        total_tokens/throughput/MFU (and the checkpointed token count).
+        """
         # 1. process + cross-process reduce + buffer pushed metrics
         processed: dict[str, float] = {}
         to_reduce: dict[str, float] = {}
@@ -143,6 +149,20 @@ class StatsTracker:
             processed.update(self.reduce_fn(to_reduce))
         for name, v in processed.items():
             self._buffer(name, v)
+
+        if not count_tokens:
+            # Out-of-band update: TB-write just the pushed metrics, then
+            # stop. Re-running the freq-1 perf collector here would compute
+            # tok/s over the eval's wall time (~0 tokens) and overwrite the
+            # step's throughput/MFU series; re-running the CLI cadence would
+            # print a duplicate line and reset the token window.
+            if self.writer is not None:
+                for name in processed:
+                    d = self.registry.get(name)
+                    v = self._window_value(d)
+                    if v is not None:
+                        self.writer.add_scalar(d.tb_tag, v, step)
+            return
 
         # 2. token accounting (:538-540)
         self.total_tokens += self.tokens_per_step

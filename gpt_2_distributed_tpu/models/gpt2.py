@@ -97,6 +97,65 @@ def count_params(params: Params) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(params))
 
 
+def _attn_sublayer(
+    config: GPT2Config,
+    x: jnp.ndarray,  # [B, T, C] in compute dtype
+    bp: dict[str, jnp.ndarray],
+    rng: jax.Array | None,
+    deterministic: bool,
+) -> jnp.ndarray:
+    """x + dropout(proj(attn(ln1(x))))."""
+    b, t, c = x.shape
+    h, d = config.n_head, config.head_dim
+    cdt = x.dtype
+    if rng is not None:
+        r_attn, r_aresid = jax.random.split(rng)
+    else:
+        r_attn = r_aresid = None
+
+    # q/k/v stay in [B, T, H, D] — the flash kernel transposes at its own
+    # boundary where XLA can fold the permute into the reshape (the
+    # reference's permute at model.py:124-129 is a layout copy on GPU).
+    y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
+    qkv = y @ bp["attn_qkv_w"].astype(cdt) + bp["attn_qkv_b"].astype(cdt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, h, d)
+    k = k.reshape(b, t, h, d)
+    v = v.reshape(b, t, h, d)
+    attn_fn = select_attention_impl(config.attention_impl, t)
+    o = attn_fn(
+        q, k, v,
+        dropout_rate=config.attn_dropout, rng=r_attn, deterministic=deterministic,
+    )
+    o = o.reshape(b, t, c)
+    o = o @ bp["attn_proj_w"].astype(cdt) + bp["attn_proj_b"].astype(cdt)
+    o = dropout(o, config.resid_dropout, r_aresid, deterministic)
+    return x + o
+
+
+def _mlp_sublayer(
+    config: GPT2Config,
+    x: jnp.ndarray,  # [B, T, C] in compute dtype
+    bp: dict[str, jnp.ndarray],
+    rng: jax.Array | None,
+    deterministic: bool,
+) -> jnp.ndarray:
+    """x + mlp(ln2(x)) — dropout after the activation AND after the
+    projection, matching the reference's extra site at model.py:188."""
+    cdt = x.dtype
+    if rng is not None:
+        r_mact, r_mresid = jax.random.split(rng)
+    else:
+        r_mact = r_mresid = None
+    y = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"], config.layer_norm_eps)
+    y = y @ bp["mlp_fc_w"].astype(cdt) + bp["mlp_fc_b"].astype(cdt)
+    y = gelu_tanh(y)
+    y = dropout(y, config.resid_dropout, r_mact, deterministic)
+    y = y @ bp["mlp_proj_w"].astype(cdt) + bp["mlp_proj_b"].astype(cdt)
+    y = dropout(y, config.resid_dropout, r_mresid, deterministic)
+    return x + y
+
+
 def _block(
     config: GPT2Config,
     x: jnp.ndarray,  # [B, T, C] in compute dtype
@@ -105,41 +164,20 @@ def _block(
     deterministic: bool,
 ) -> jnp.ndarray:
     """One pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x))."""
-    b, t, c = x.shape
-    h, d = config.n_head, config.head_dim
-    cdt = x.dtype
     if rng is not None:
-        r_attn, r_aresid, r_mact, r_mresid = jax.random.split(rng, 4)
+        r_attn, r_mlp = jax.random.split(rng)
     else:
-        r_attn = r_aresid = r_mact = r_mresid = None
-
-    # Attention sublayer
-    y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
-    qkv = y @ bp["attn_qkv_w"].astype(cdt) + bp["attn_qkv_b"].astype(cdt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    # [B, T, C] -> [B, H, T, D]
-    q = q.reshape(b, t, h, d).transpose(0, 2, 1, 3)
-    k = k.reshape(b, t, h, d).transpose(0, 2, 1, 3)
-    v = v.reshape(b, t, h, d).transpose(0, 2, 1, 3)
-    attn_fn = select_attention_impl(config.attention_impl, t)
-    o = attn_fn(
-        q, k, v,
-        dropout_rate=config.attn_dropout, rng=r_attn, deterministic=deterministic,
-    )
-    o = o.transpose(0, 2, 1, 3).reshape(b, t, c)
-    o = o @ bp["attn_proj_w"].astype(cdt) + bp["attn_proj_b"].astype(cdt)
-    o = dropout(o, config.resid_dropout, r_aresid, deterministic)
-    x = x + o
-
-    # MLP sublayer (dropout after the activation AND after the projection,
-    # matching the reference's extra site at model.py:188)
-    y = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"], config.layer_norm_eps)
-    y = y @ bp["mlp_fc_w"].astype(cdt) + bp["mlp_fc_b"].astype(cdt)
-    y = gelu_tanh(y)
-    y = dropout(y, config.resid_dropout, r_mact, deterministic)
-    y = y @ bp["mlp_proj_w"].astype(cdt) + bp["mlp_proj_b"].astype(cdt)
-    y = dropout(y, config.resid_dropout, r_mresid, deterministic)
-    return x + y
+        r_attn = r_mlp = None
+    x = _attn_sublayer(config, x, bp, r_attn, deterministic)
+    mlp = _mlp_sublayer
+    if config.remat == "mlp":
+        # Sublayer remat: save the attention sublayer (its flash-kernel
+        # forward is expensive to replay and its residuals are small), replay
+        # only the MLP — whose 4C-wide activations dominate saved-activation
+        # memory. Cuts the remat recompute from a full extra forward to the
+        # MLP half, and the attention kernel runs once, not twice.
+        mlp = jax.checkpoint(_mlp_sublayer, static_argnums=(0, 4))
+    return mlp(config, x, bp, r_mlp, deterministic)
 
 
 def forward(
@@ -199,14 +237,17 @@ def forward(
                          deterministic)
             return out, None
 
-        if config.remat:
+        if config.remat and config.remat != "mlp":
+            # Full-block remat ("block"/True); the "mlp" sublayer policy is
+            # applied inside _block itself.
             body = jax.checkpoint(body)
         x, _ = jax.lax.scan(body, x, (block_params, layer_rngs))
     else:
+        full_remat = config.remat and config.remat != "mlp"
         for i in range(config.n_layer):
             bp = jax.tree_util.tree_map(lambda a: a[i], block_params)
             lr = jax.random.fold_in(r_blocks, i) if r_blocks is not None else None
-            blk = jax.checkpoint(_block, static_argnums=(0, 4)) if config.remat else _block
+            blk = jax.checkpoint(_block, static_argnums=(0, 4)) if full_remat else _block
             x = blk(config, x, bp, lr, deterministic)
 
     x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)

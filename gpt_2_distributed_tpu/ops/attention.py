@@ -55,24 +55,46 @@ def causal_attention(
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def causal_attention_bthd(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    **kwargs,
+) -> jnp.ndarray:
+    """Dense causal attention over the model's native [B, T, H, D] layout.
+
+    The transposes here are the head-major round trip the flash kernel
+    avoids entirely (its BlockSpecs index the head dim in place); the dense
+    parity path keeps them, and XLA typically folds them into the adjacent
+    matmuls."""
+    out = causal_attention(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        **kwargs,
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
 def select_attention_impl(impl: str, seq_len: int):
-    """Resolve an attention implementation name to a callable with the
-    ``causal_attention`` signature. Called at trace time (static shapes)."""
+    """Resolve an attention implementation name to a callable taking
+    ``[B, T, H, D]`` q/k/v (the model's native layout — no head transpose on
+    the hot path). Called at trace time (static shapes)."""
     from gpt_2_distributed_tpu.ops.flash_attention import (
-        DEFAULT_BLOCK_Q,
-        flash_attention,
+        flash_attention_bthd,
+        pick_block_q,
     )
 
     if impl == "dense":
-        return causal_attention
+        return causal_attention_bthd
     if impl == "flash":
-        return flash_attention
+        return flash_attention_bthd
     if impl == "auto":
         import jax
 
         flash_ok = (
-            seq_len % DEFAULT_BLOCK_Q == 0
+            pick_block_q(seq_len) is not None
             and jax.devices()[0].platform == "tpu"
         )
-        return flash_attention if flash_ok else causal_attention
+        return flash_attention_bthd if flash_ok else causal_attention_bthd
     raise ValueError(f"unknown attention_impl {impl!r}; expected dense|flash|auto")

@@ -3,18 +3,35 @@
 The reference materializes dense ``[B, H, T, T]`` score/prob tensors in HBM
 (``/root/reference/model.py:137-151``) — at seq 1024 that is the dominant HBM
 traffic and the activation-memory cap on micro-batch size (SURVEY.md §5.7).
-This kernel keeps the score block resident in VMEM: per ``(batch, head,
-q-block)`` grid step it computes a ``[block_q, T]`` score stripe against the
-full K/V (which fit comfortably in VMEM at GPT-2 scales: T=1024, D=64 ->
-256 KB), applies the causal mask and a row softmax, optional probability
-dropout from the TPU hardware PRNG, and contracts with V — nothing O(T^2)
-ever touches HBM.
+This kernel keeps everything O(T^2) resident in VMEM via the online-softmax
+flash recurrence, so nothing quadratic ever touches HBM.
+
+Throughput design (what round-1/round-2 profiling taught):
+
+* **bf16 MXU inputs.** All dots take bf16 operands with fp32 accumulation
+  (``preferred_element_type``) — fp32 operands cost multiple MXU passes.
+  Probabilities are cast to bf16 before the ``p @ v`` contraction, exactly
+  like the dense XLA path (``ops/attention.py`` casts probs to q's dtype).
+* **k-blocks live in the GRID, not a fori_loop.** The grid is
+  ``(batch, heads, nq, nk)`` with the k-block index innermost; Mosaic
+  double-buffers the K/V block copies across grid steps, overlapping HBM
+  loads with compute. A ``fori_loop`` over k inside the kernel (the round-2
+  first attempt) serializes those loads and measured notably slower.
+* **Causal skipping via pl.when.** Grid steps with ``j > qi`` (above the
+  diagonal) skip all compute — ~44% of score work at nq=2. The online
+  accumulators (m, l, acc) are VMEM scratch carried across the inner grid
+  dimension; outputs are written at the diagonal step ``j == qi``.
+* **Head-major [B, H, T, D] blocks.** Mosaic's (sublane, lane) tiling lives
+  on the last two dims, so blocks must be [.., .., block_q, D]; slicing a
+  middle head dim inside the kernel is an unsupported relayout. The
+  [B, T, H, D]-shaped entry point transposes at the boundary; XLA fuses that
+  into the surrounding reshape.
 
 Backward is a custom VJP (one Pallas kernel): per q-block it regenerates the
-probabilities from the saved log-sum-exp (the flash-attention trick — no
-stored probs), regenerates the *identical* dropout bits by reseeding the PRNG
-with the same (batch, head, q-block)-derived seed, and produces dq per block
-plus dk/dv accumulated across q-blocks into VMEM-resident outputs.
+probabilities from the saved log-sum-exp (no stored probs), regenerates the
+*identical* dropout bits by rehashing the same absolute (batch, head, row,
+col) coordinates, and accumulates dq per q-block (VMEM scratch) plus dk/dv
+into full-[T, D] VMEM-resident fp32 outputs per (batch, head).
 
 Numerics vs. the dense path: the dense reference masks scores to -1e4
 (``model.py:144``); here masked lanes get -1e30 before the row max — for
@@ -25,10 +42,10 @@ fp32; inputs/outputs are the model's compute dtype (bf16).
 Dropout semantics match ``torch.nn.functional.dropout`` on the normalized
 probabilities: ``o = (mask * P / keep_prob) @ v``. In-kernel we apply the mask
 to the unnormalized exponentials and divide by the *undropped* row sum, which
-is algebraically the same. The dropout RNG stream is the TPU PRNG, not
-``jax.random`` — masks differ from the dense implementation run-to-run, which
-is within the reference's contract (dropout is stochastic; determinism holds
-per seed per implementation).
+is algebraically the same. The dropout RNG stream is the counter-based hash
+below, not ``jax.random`` — masks differ from the dense implementation
+run-to-run, which is within the reference's contract (dropout is stochastic;
+determinism holds per seed per implementation).
 """
 
 from __future__ import annotations
@@ -41,16 +58,27 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # causal mask fill for fp32 row-max stability (see docstring)
-DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_Q = 512  # fastest on v5e at seq 1024 (256/512/1024 swept)
 
 
-def _dropout_bits(seed, b, h, qi, block_q, t):
-    """Counter-based uint32 random bits for one [block_q, T] stripe.
+def pick_block_q(t: int, preferred: int = DEFAULT_BLOCK_Q) -> int | None:
+    """Largest viable block size dividing ``t``: the preferred size if it
+    divides, else the next power-of-two down to 128 (Mosaic's lane width —
+    smaller stripes under-fill the tile). None if nothing divides, in which
+    case callers fall back to dense attention."""
+    for cand in (min(preferred, t), 512, 256, 128):
+        if cand <= t and t % cand == 0 and cand % 128 == 0:
+            return cand
+    return None
+
+
+def _dropout_bits(seed, b, h, row_off, col_off, shape):
+    """Counter-based uint32 random bits for one [rows, cols] tile.
 
     A murmur3-finalizer hash of the absolute (batch, head, row, col) position
-    mixed with the seed — stateless, so the backward kernel regenerates the
-    forward's exact mask by construction, and the same bits come out on TPU
-    and in CPU interpret mode (pltpu's hardware PRNG has no CPU lowering).
+    mixed with the seed — stateless and blocking-independent, so the backward
+    kernel regenerates the forward's exact mask by construction, and the same
+    bits come out on TPU and in CPU interpret mode.
     """
     # Everything must be uint32 BEFORE any arithmetic: a stray int32 operand
     # promotes the whole expression and turns >> into an arithmetic shift on
@@ -58,11 +86,12 @@ def _dropout_bits(seed, b, h, qi, block_q, t):
     # ids disagree with Python ints).
     b = jnp.asarray(b).astype(jnp.uint32)
     h = jnp.asarray(h).astype(jnp.uint32)
-    qi = jnp.asarray(qi).astype(jnp.uint32)
-    row = qi * jnp.uint32(block_q) + jax.lax.broadcasted_iota(
-        jnp.uint32, (block_q, t), 0
+    row = jnp.asarray(row_off).astype(jnp.uint32) + jax.lax.broadcasted_iota(
+        jnp.uint32, shape, 0
     )
-    col = jax.lax.broadcasted_iota(jnp.uint32, (block_q, t), 1)
+    col = jnp.asarray(col_off).astype(jnp.uint32) + jax.lax.broadcasted_iota(
+        jnp.uint32, shape, 1
+    )
     x = (
         seed.astype(jnp.uint32)
         ^ (b * jnp.uint32(0x9E3779B1))
@@ -80,136 +109,184 @@ def _dropout_bits(seed, b, h, qi, block_q, t):
 def _fwd_kernel(
     seed_ref,  # scalar prefetch: [1] int32
     q_ref,     # [1, 1, bq, D]
-    k_ref,     # [1, 1, T, D]
-    v_ref,     # [1, 1, T, D]
+    k_ref,     # [1, 1, bk, D]
+    v_ref,     # [1, 1, bk, D]
     o_ref,     # [1, 1, bq, D]
-    lse_ref,   # [1, 1, bq, 1]
+    lse_ref,   # [1, 1, bq, 1] f32
+    m_scr,     # VMEM scratch [bq, 1] f32
+    l_scr,     # VMEM scratch [bq, 1] f32
+    acc_scr,   # VMEM scratch [bq, D] f32
     *,
     block_q: int,
     dropout_rate: float,
 ):
-    b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    t = k_ref.shape[2]
+    b, h, qi, j = (pl.program_id(0), pl.program_id(1),
+                   pl.program_id(2), pl.program_id(3))
+    bq = block_q
     d = q_ref.shape[3]
     scale = 1.0 / (d ** 0.5)
 
-    q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
-    k = k_ref[0, 0].astype(jnp.float32)          # [T, D]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale                                     # [bq, T]
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 1)
-    s = jnp.where(col <= row, s, NEG_INF)
+    def _compute(masked: bool):
+        # The 1/sqrt(d) scale is folded into q ([bq, D]) instead of s
+        # ([bq, bk]) — one fewer full-stripe VPU pass.
+        q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        k = k_ref[0, 0]                               # [bk, D] bf16
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [bq, bk] f32
+        if masked:
+            # Only the diagonal block pays the triangular mask; off-diagonal
+            # blocks (j < qi) are fully unmasked and skip these VPU passes.
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(col <= row, s, NEG_INF)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                        # [bq, bk] f32
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            bits = _dropout_bits(seed_ref[0], b, h, qi * bq, j * bq, s.shape)
+            threshold = jnp.uint32(int(dropout_rate * (2**32)))
+            p = jnp.where(bits >= threshold, p / (1.0 - dropout_rate), 0.0)
+        v = v_ref[0, 0]                               # [bk, D] bf16
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    m = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
-    p = jnp.exp(s - m)                            # [bq, T]
-    l = jnp.sum(p, axis=-1, keepdims=True)        # [bq, 1]
-    lse_ref[0, 0] = m + jnp.log(l)     # [bq, 1]
+    pl.when(j < qi)(lambda: _compute(masked=False))
+    pl.when(j == qi)(lambda: _compute(masked=True))
 
-    if dropout_rate > 0.0:
-        bits = _dropout_bits(seed_ref[0], b, h, qi, block_q, t)
-        threshold = jnp.uint32(int(dropout_rate * (2**32)))
-        keep = bits >= threshold
-        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-
-    v = v_ref[0, 0].astype(jnp.float32)           # [T, D]
-    o = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) / l                                         # [bq, D]
-    o_ref[0, 0] = o.astype(o_ref.dtype)
+    @pl.when(j == qi)
+    def _finalize():
+        l = l_scr[...]
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
 def _bwd_kernel(
     seed_ref,   # scalar prefetch: [1] int32
     q_ref,      # [1, 1, bq, D]
-    k_ref,      # [1, 1, T, D]
-    v_ref,      # [1, 1, T, D]
+    k_ref,      # [1, 1, bk, D]
+    v_ref,      # [1, 1, bk, D]
     do_ref,     # [1, 1, bq, D]
     lse_ref,    # [1, 1, bq, 1]
     delta_ref,  # [1, 1, bq, 1]
-    dq_ref,     # [1, 1, bq, D]  per-block
-    dk_ref,     # [1, 1, T, D]   accumulated across q-blocks (fp32)
-    dv_ref,     # [1, 1, T, D]   accumulated across q-blocks (fp32)
+    dq_ref,     # [1, 1, bq, D]
+    dk_ref,     # [1, 1, T, D] f32, accumulated across (qi, j) per (b, h)
+    dv_ref,     # [1, 1, T, D] f32
+    dq_scr,     # VMEM scratch [bq, D] f32
     *,
     block_q: int,
     dropout_rate: float,
 ):
-    b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    t = k_ref.shape[2]
+    b, h, qi, j = (pl.program_id(0), pl.program_id(1),
+                   pl.program_id(2), pl.program_id(3))
+    bq = block_q
     d = q_ref.shape[3]
     scale = 1.0 / (d ** 0.5)
+    kp = 1.0 - dropout_rate
 
-    @pl.when(qi == 0)
-    def _init():
+    @pl.when((qi == 0) & (j == 0))
+    def _init_kv():
         dk_ref[...] = jnp.zeros_like(dk_ref)
         dv_ref[...] = jnp.zeros_like(dv_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)          # [bq, D]
-    lse = lse_ref[0, 0]                            # [bq, 1]
-    delta = delta_ref[0, 0]                        # [bq, 1]
+    @pl.when(j == 0)
+    def _init_dq():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                                      # [bq, T]
-    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 1)
-    s = jnp.where(col <= row, s, NEG_INF)
-    p = jnp.exp(s - lse)                           # normalized probs P [bq, T]
+    def _compute(masked: bool):
+        # Scale folded into q (see fwd kernel); the same scaled-q feeds the
+        # s recompute AND the dk contraction, whose extra *scale cancels the
+        # chain rule's — dk = scale * ds^T @ q = ds^T @ (scale * q).
+        q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        k = k_ref[0, 0]                               # [bk, D] bf16
+        v = v_ref[0, 0]                               # [bk, D] bf16
+        do = do_ref[0, 0]                             # [bq, D] bf16
+        lse = lse_ref[0, 0]                           # [bq, 1] f32
+        delta = delta_ref[0, 0]                       # [bq, 1] f32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [bq, bk] f32
+        if masked:
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(col <= row, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # normalized probs
+        dpd = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # dL/d(dropped P)
+        if dropout_rate > 0.0:
+            bits = _dropout_bits(seed_ref[0], b, h, qi * bq, j * bq, s.shape)
+            keep = bits >= jnp.uint32(int(dropout_rate * (2**32)))
+            pd = jnp.where(keep, p / kp, 0.0)         # dropped+rescaled probs
+            dp = jnp.where(keep, dpd / kp, 0.0)       # dL/dP
+        else:
+            pd = p
+            dp = dpd
 
-    # dPd = do @ v^T; dP = mask*dPd/kp; Pd = mask*P/kp
-    dpd = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )                                              # [bq, T]
-    if dropout_rate > 0.0:
-        bits = _dropout_bits(seed_ref[0], b, h, qi, block_q, t)
-        threshold = jnp.uint32(int(dropout_rate * (2**32)))
-        keep = bits >= threshold
-        kp = 1.0 - dropout_rate
-        pd = jnp.where(keep, p / kp, 0.0)          # dropped+rescaled probs
-        dp = jnp.where(keep, dpd / kp, 0.0)        # dL/dP
-    else:
-        pd = p
-        dp = dpd
-
-    ds = p * (dp - delta)                          # [bq, T] softmax bwd
-    dq_ref[0, 0] = (
-        jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ds = (p * (dp - delta)).astype(q.dtype)       # [bq, bk] bf16
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         ) * scale
-    ).astype(dq_ref.dtype)
-    dk_ref[0, 0] += jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                                      # [T, D]
-    dv_ref[0, 0] += jax.lax.dot_general(
-        pd, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )                                              # [T, D]
+        dk_ref[0, 0, pl.ds(j * bq, bq), :] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [bk, D] (scale in q)
+        dv_ref[0, 0, pl.ds(j * bq, bq), :] += jax.lax.dot_general(
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [bk, D]
+
+    pl.when(j < qi)(lambda: _compute(masked=False))
+    pl.when(j == qi)(lambda: _compute(masked=True))
+
+    @pl.when(j == qi)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 @functools.lru_cache(maxsize=None)
 def _build(dropout_rate: float, block_q: int, interpret: bool):
-    """Build the custom-VJP flash attention for one static config."""
+    """Build the custom-VJP flash attention ([B, H, T, D]) for one config."""
 
     def fwd_call(q, k, v, seed):
         batch, heads, t, d = q.shape
         nq = t // block_q
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(batch, heads, nq),
+            grid=(batch, heads, nq, nq),
             in_specs=[
-                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, i, j, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, i, j, *_: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, i, j, *_: (b, h, j, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, i, j, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda b, h, i, j, *_: (b, h, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
             ],
         )
         o, lse = pl.pallas_call(
@@ -239,23 +316,36 @@ def _build(dropout_rate: float, block_q: int, interpret: bool):
         batch, heads, t, d = q.shape
         nq = t // block_q
         delta = jnp.sum(
-            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
-        )
+            do.astype(jnp.float32) * o.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )                                             # [B, H, T, 1]
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(batch, heads, nq),
+            grid=(batch, heads, nq, nq),
             in_specs=[
-                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, i, j, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, i, j, *_: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, i, j, *_: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, i, j, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda b, h, i, j, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda b, h, i, j, *_: (b, h, i, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, t, d), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, h, i, j, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, t, d),
+                             lambda b, h, i, j, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, t, d),
+                             lambda b, h, i, j, *_: (b, h, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
             ],
         )
         dq, dk, dv = pl.pallas_call(
@@ -287,15 +377,18 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """Causal flash attention. Drop-in for ``ops.attention.causal_attention``.
+    """Causal flash attention, drop-in for ``ops.attention.causal_attention``.
 
     Requires ``T % block_q == 0`` (the driver picks block_q <= T). ``rng``
-    seeds the in-kernel dropout PRNG when training.
+    seeds the in-kernel dropout hash when training.
     """
     t = q.shape[2]
-    block_q = min(block_q, t)
-    if t % block_q:
-        raise ValueError(f"flash attention needs T % block_q == 0, got T={t}")
+    block_q = pick_block_q(t, block_q)
+    if block_q is None:
+        raise ValueError(
+            f"flash attention needs T divisible by a viable block size "
+            f"(512/256/128), got T={t}"
+        )
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     rate = float(dropout_rate) if (not deterministic and rng is not None) else 0.0
@@ -305,3 +398,25 @@ def flash_attention(
     else:
         seed = jnp.zeros((1,), jnp.int32)
     return _build(rate, block_q, interpret)(q, k, v, seed)
+
+
+def flash_attention_bthd(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    **kwargs,
+) -> jnp.ndarray:
+    """[B, T, H, D] entry point (the model's native layout).
+
+    The transpose to head-major happens here, at the kernel boundary — XLA
+    folds it into the surrounding reshapes; Mosaic itself cannot slice a
+    middle head dim out of a (sublane, lane)-tiled block (see module
+    docstring), so the kernel operates head-major.
+    """
+    out = flash_attention(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        **kwargs,
+    )
+    return out.transpose(0, 2, 1, 3)

@@ -60,12 +60,23 @@ def init_distributed(
     if process_id is None:
         r = os.environ.get("PROCESS_ID") or os.environ.get("RANK")
         process_id = int(r) if r else None
-    if coordinator_address is None or (num_processes is not None and num_processes <= 1):
-        # No coordinator (covers leftover WORLD_SIZE=1/RANK=0 env residue
-        # without a MASTER_ADDR, where initialize would raise) or an
-        # explicitly single-process job: nothing to initialize. A coordinator
-        # with num_processes unset DOES initialize — jax auto-detects the
-        # process count on Cloud TPU.
+    if num_processes is not None and num_processes <= 1:
+        # Explicitly single-process: nothing to initialize.
+        return
+    if coordinator_address is None:
+        # No explicit coordinator. On a Cloud TPU pod slice the libtpu
+        # environment advertises the worker set (TPU_WORKER_HOSTNAMES /
+        # TPU_WORKER_ID are set on every TPU VM of a multi-worker slice);
+        # there jax.distributed.initialize() with no arguments auto-detects
+        # coordinator, process count and process id — this is the path
+        # scripts/run_training_tpu_pod.sh documents ("simply run this on all
+        # workers"). Anything else (local runs, CPU tests, WORLD_SIZE=1/RANK=0
+        # env residue without a MASTER_ADDR) is single-process: return.
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        multi_host_tpu = "," in hostnames
+        if not multi_host_tpu:
+            return
+        jax.distributed.initialize()
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

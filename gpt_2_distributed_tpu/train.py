@@ -78,6 +78,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup_steps", type=int, default=0)
     p.add_argument("--max_steps", type=int, default=0, help="stop after N optimizer steps (0 = no cap)")
     p.add_argument("--weight_decay", type=float, default=0.1)
+    p.add_argument(
+        "--eval_every", type=int, default=0,
+        help="evaluate on the val split every N optimizer steps (0 = off)",
+    )
+    p.add_argument(
+        "--eval_batches", type=int, default=16,
+        help="number of val batches per evaluation",
+    )
     p.add_argument("--save_every", type=int, default=1000)
     p.add_argument("--save_dir", default=None)
     p.add_argument("--log_dir", default=None)
@@ -85,7 +93,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch_factor", type=int, default=DEFAULT_PREFETCH_FACTOR)
     p.add_argument("--seed", type=int, default=DEFAULT_SEED)
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint in --save_dir")
-    p.add_argument("--remat", action="store_true", help="activation checkpointing")
+    p.add_argument(
+        "--remat", nargs="?", const="block", default=False,
+        choices=["block", "mlp"],
+        help="activation checkpointing: 'block' (full, lowest memory; the "
+        "bare flag means this) or 'mlp' (remat only the MLP sublayer — "
+        "attention runs once; the throughput sweet spot when memory allows)",
+    )
     p.add_argument("--profile", action="store_true", help="jax.profiler trace into --log_dir")
     p.add_argument("--cli_every", type=int, default=20)
     p.add_argument("--tb_every", type=int, default=1)
@@ -93,6 +107,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
     return p
+
+
+def _common_min(value: int) -> int:
+    """Cross-process minimum of a host scalar (identity single-process).
+
+    Every quantity that bounds a loop of collective steps — batches per
+    epoch, eval batch count, the LR-schedule horizon — must be identical on
+    all processes, or hosts dispatch different collective sequences and the
+    job deadlocks / parameters silently diverge. The dataloader's round-robin
+    shard assignment makes per-process batch counts unequal (shard-count
+    remainders), so the common value is the minimum.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return int(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return int(np.min(multihost_utils.process_allgather(
+        np.asarray(value, np.int64))))
 
 
 def make_lr_schedule(args, steps_per_epoch: int):
@@ -134,6 +169,7 @@ def main(argv: list[str] | None = None) -> None:
         shard_params_and_opt_state,
     )
     from gpt_2_distributed_tpu.parallel.train_step import (
+        make_eval_step,
         make_optimizer,
         make_train_step,
     )
@@ -165,11 +201,18 @@ def main(argv: list[str] | None = None) -> None:
         num_workers=args.workers,
         vocab_size=config.vocab_size,
     )
-    # One optimizer step consumes grad_accum local micro-batches.
-    steps_per_epoch = dataset.batches_per_epoch(local_batch) // args.grad_accum_steps
+    # One optimizer step consumes grad_accum local micro-batches. The count
+    # feeds the cosine schedule's decay horizon, so it must be the
+    # cross-process common value — per-process counts differ (see _common_min).
+    steps_per_epoch = (
+        _common_min(dataset.batches_per_epoch(local_batch))
+        // args.grad_accum_steps
+    )
     if is_primary():
+        from gpt_2_distributed_tpu.utils.device_info import print_device_info
+
+        print_device_info()
         print(
-            f"devices: {jax.device_count()} ({jax.devices()[0].device_kind}) | "
             f"mesh: data={spec.data}, fsdp={spec.fsdp} | model: {args.model} "
             f"({config.num_params()/1e6:.1f}M params) | "
             f"steps/epoch: {steps_per_epoch}"
@@ -225,6 +268,63 @@ def main(argv: list[str] | None = None) -> None:
         )
         tracker.total_tokens = total_tokens
 
+        # --- evaluation -------------------------------------------------------
+        # Consumes the val split (shard 0 by the tokenizer's convention) the
+        # reference reserves but never reads. Deterministic: epoch-0
+        # permutation every time, so successive evals see the same batches.
+        run_eval = None
+        if args.eval_every:
+            val_paths = get_shard_paths(args.data_dir, "val")
+            # All processes must agree on whether eval runs at all — a host
+            # with a partially-synced data_dir skipping eval while others run
+            # its collectives would desynchronize the whole job.
+            if not _common_min(int(bool(val_paths))):
+                if is_primary():
+                    print(
+                        f"--eval_every: no 'val' shards in {args.data_dir} on "
+                        f"every process; eval disabled"
+                    )
+                val_paths = []
+            if val_paths:
+                # Deliberately UNsharded (process 0-of-1 identity): the
+                # pipeline's convention is a single val shard (shard 0), so
+                # process-striding would give every host but one zero batches
+                # and n_eval would collapse to 0. Each process streams the
+                # same windows instead; its shard_batch slice duplicates data
+                # across hosts, which leaves the mean eval loss unchanged.
+                eval_dataset = TokenShardDataset(
+                    val_paths, seq_len=args.seq_len, num_workers=1,
+                    process_index=0, process_count=1,
+                    vocab_size=config.vocab_size,
+                )
+                eval_dataset.set_epoch(0)
+                eval_step = make_eval_step(config)
+                n_eval = min(
+                    args.eval_batches,
+                    _common_min(eval_dataset.batches_per_epoch(local_batch)),
+                )
+                if n_eval == 0:
+                    if is_primary():
+                        print(
+                            "--eval_every: val split has fewer tokens than "
+                            f"one batch ({local_batch}x{args.seq_len}); "
+                            "eval disabled"
+                        )
+                else:
+                    def run_eval(cur_params) -> float:
+                        losses = []
+                        loader = create_dataloader(
+                            eval_dataset, batch_size=local_batch,
+                            prefetch_factor=args.prefetch_factor,
+                        )
+                        for i, (xb, yb) in enumerate(loader):
+                            if i >= n_eval:
+                                break
+                            xs, ys = shard_batch((xb, yb), mesh,
+                                                 leading_accum_axis=False)
+                            losses.append(float(eval_step(cur_params, xs, ys)))
+                        return float(np.mean(losses))
+
         if args.profile and args.log_dir:
             jax.profiler.start_trace(os.path.join(args.log_dir, "profile"))
 
@@ -245,10 +345,12 @@ def main(argv: list[str] | None = None) -> None:
                 return
             p_step, p_epoch, p_batch, p_m = pending
             pending = None
+            # p_step is the post-increment global step; optax evaluated the
+            # schedule at count p_step - 1 for that update, so log that one.
             tracker.update(
                 p_step,
                 loss=float(p_m.loss),
-                lr=float(lr_of(p_step)),
+                lr=float(lr_of(p_step - 1)),
                 grad_norm=float(p_m.grad_norm),
                 epoch=p_epoch,
                 batch=p_batch,
@@ -269,8 +371,22 @@ def main(argv: list[str] | None = None) -> None:
             step_in_epoch = skip_steps if epoch == start_epoch else 0
             skip_for_this_epoch = step_in_epoch
 
+            # Every optimizer step is a collective: a process whose local
+            # loader yields more batches than another's would dispatch an
+            # extra train_step and block forever on its psum. Bound the epoch
+            # by the cross-process MINIMUM step count — the drop-to-common-
+            # length behavior torch's DistributedSampler gives the reference
+            # implicitly (round-robin shard remainders make per-process batch
+            # counts unequal here).
+            epoch_opt_steps = (
+                _common_min(dataset.batches_per_epoch(local_batch))
+                // args.grad_accum_steps
+            )
+
             micro: list[tuple[np.ndarray, np.ndarray]] = []
             for xb, yb in loader:
+                if step_in_epoch >= epoch_opt_steps:
+                    break
                 micro.append((xb, yb))
                 if len(micro) < args.grad_accum_steps:
                     continue
@@ -286,6 +402,14 @@ def main(argv: list[str] | None = None) -> None:
                 flush_pending()
                 pending = (global_step, epoch, step_in_epoch, m)
 
+                if run_eval is not None and global_step % args.eval_every == 0:
+                    flush_pending()
+                    # count_tokens=False: this step's training update already
+                    # counted its tokens; eval is out-of-band.
+                    tracker.update(
+                        global_step, count_tokens=False,
+                        eval_loss=run_eval(params),
+                    )
                 if args.save_dir and args.save_every and global_step % args.save_every == 0:
                     flush_pending()
                     last_saved_step = global_step

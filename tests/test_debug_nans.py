@@ -1,0 +1,33 @@
+"""The jax_debug_nans CI lane (SURVEY.md §5.2): a short real training run with
+NaN-checking enabled. jax re-checks every primitive's outputs under this flag,
+so a NaN produced anywhere in the step (loss, grads, optimizer update) fails
+loudly here instead of silently corrupting a long run.
+"""
+
+import jax
+import pytest
+
+from gpt_2_distributed_tpu import train as train_mod
+
+
+@pytest.mark.nan_debug
+def test_short_train_with_debug_nans(capsys, shard_dir, tmp_path):
+    jax.config.update("jax_debug_nans", True)
+    try:
+        train_mod.main([
+            "--data_dir", shard_dir,
+            "--n_layer", "2",
+            "--n_embd", "32",
+            "--n_head", "2",
+            "--vocab_size", "257",
+            "--seq_len", "32",
+            "--batch", "4",
+            "--grad_accum_steps", "2",
+            "--max_steps", "4",
+            "--lr", "3e-3",
+            "--cli_every", "1",
+        ])
+    finally:
+        jax.config.update("jax_debug_nans", False)
+    out = capsys.readouterr().out
+    assert "training done: 4 optimizer steps" in out

@@ -25,8 +25,12 @@ def make_qkv(B=2, H=3, T=256, D=64, seed=0, dtype=jnp.float32):
     return mk(), mk(), mk()
 
 
-def dense_oracle_with_kernel_mask(q, k, v, seed_scalar, rate, block_q=128):
-    """Dense attention applying the kernel's exact dropout mask."""
+def dense_oracle_with_kernel_mask(q, k, v, seed_scalar, rate):
+    """Dense attention applying the kernel's exact dropout mask.
+
+    The kernel's bits are a pure hash of absolute (batch, head, row, col), so
+    one full-[T, T] call reproduces every tile the kernel generates regardless
+    of its blocking."""
     B, H, T, D = q.shape
     scale = 1.0 / np.sqrt(D)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
@@ -35,17 +39,20 @@ def dense_oracle_with_kernel_mask(q, k, v, seed_scalar, rate, block_q=128):
     p = jax.nn.softmax(s, axis=-1)
     if rate > 0.0:
         threshold = jnp.uint32(int(rate * (2**32)))
-        keeps = []
-        for b in range(B):
-            row = []
-            for h in range(H):
-                blocks = [
-                    _dropout_bits(seed_scalar, b, h, qi, block_q, T)
-                    for qi in range(T // block_q)
+        keep = (
+            jnp.stack(
+                [
+                    jnp.stack(
+                        [
+                            _dropout_bits(seed_scalar, b, h, 0, 0, (T, T))
+                            for h in range(H)
+                        ]
+                    )
+                    for b in range(B)
                 ]
-                row.append(jnp.concatenate(blocks, axis=0))
-            keeps.append(jnp.stack(row))
-        keep = jnp.stack(keeps) >= threshold
+            )
+            >= threshold
+        )
         p = jnp.where(keep, p / (1.0 - rate), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
@@ -125,10 +132,75 @@ def test_dropout_bwd_matches_dense_oracle():
         )
 
 
+def test_multiblock_fwd_bwd_matches_dense():
+    """nq=4 (T=512, block_q=128): exercises the online-softmax rescaling, the
+    pl.when(j < qi) unmasked branch, dq accumulation across k-blocks, and the
+    pl.ds dk/dv slice accumulation — none of which run at nq=1."""
+    q, k, v = make_qkv(B=1, H=2, T=512)
+
+    def loss_d(q, k, v):
+        return (causal_attention(q, k, v) ** 2).sum()
+
+    def loss_f(q, k, v):
+        return (
+            flash_attention(q, k, v, block_q=128, interpret=True) ** 2
+        ).sum()
+
+    o_d = causal_attention(q, k, v)
+    o_f = flash_attention(q, k, v, block_q=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_d), atol=2e-5)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gf):
+        scale = float(jnp.abs(a).max())
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=3e-5 * max(scale, 1.0)
+        )
+
+
+def test_multiblock_dropout_bwd_matches_dense_oracle():
+    """Dropout column offsets (j*bq != 0) must line up between the kernel's
+    per-block hash tiles and the oracle's full-[T, T] mask."""
+    q, k, v = make_qkv(B=1, H=1, T=256)
+    key = jax.random.PRNGKey(7)
+    seed = jax.random.randint(key, (1,), 0, jnp.iinfo(jnp.int32).max, jnp.int32)
+
+    def loss_f(q, k, v):
+        return (
+            flash_attention(
+                q, k, v, dropout_rate=0.1, rng=key, deterministic=False,
+                block_q=128, interpret=True,
+            ) ** 2
+        ).sum()
+
+    def loss_d(q, k, v):
+        return (dense_oracle_with_kernel_mask(q, k, v, seed[0], 0.1) ** 2).sum()
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gf):
+        scale = float(jnp.abs(a).max())
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=3e-5 * max(scale, 1.0)
+        )
+
+
+def test_pick_block_q():
+    from gpt_2_distributed_tpu.ops.flash_attention import pick_block_q
+
+    assert pick_block_q(1024) == 512
+    assert pick_block_q(512) == 512
+    assert pick_block_q(256) == 256
+    assert pick_block_q(128) == 128
+    assert pick_block_q(640) == 128   # not divisible by 512/256; 128 works
+    assert pick_block_q(200) is None  # no 128-multiple divides it
+    assert pick_block_q(64) is None   # below the minimum stripe
+
+
 def test_dropout_rate_statistics():
     q, k, v = make_qkv(B=1, H=1, T=256)
     seed = jnp.int32(1234)
-    bits = _dropout_bits(seed, 0, 0, 0, 128, 256)
+    bits = _dropout_bits(seed, 0, 0, 0, 0, (128, 256))
     frac = float((bits < jnp.uint32(int(0.1 * 2**32))).mean())
     assert 0.05 < frac < 0.15  # ~10% dropped
 

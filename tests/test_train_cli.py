@@ -92,6 +92,30 @@ def test_cli_fsdp_mode_runs(capsys, shard_dir, tmp_path):
     assert losses and all(l > 0 for l in losses)
 
 
+def test_cli_eval_every(capsys, shard_dir, tmp_path):
+    """--eval_every runs make_eval_step over the val split (shard 0) and logs
+    eval_loss through the tracker (VERDICT round-1 gap #4)."""
+    out = run_cli(
+        capsys,
+        "--data_dir", shard_dir,
+        "--n_layer", "2",
+        "--n_embd", "32",
+        "--n_head", "2",
+        "--vocab_size", "257",
+        "--seq_len", "32",
+        "--batch", "4",
+        "--grad_accum_steps", "1",
+        "--max_steps", "4",
+        "--eval_every", "2",
+        "--eval_batches", "2",
+        "--cli_every", "1",
+        "--log_dir", str(tmp_path / "tb"),
+    )
+    evals = [float(m) for m in re.findall(r"eval_loss: ([0-9.]+)", out)]
+    assert len(evals) >= 2, f"expected eval_loss lines:\n{out}"
+    assert all(e > 0 for e in evals)
+
+
 def test_cli_explicit_mesh(capsys, shard_dir):
     out = run_cli(
         capsys,

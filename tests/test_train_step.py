@@ -66,8 +66,13 @@ def test_grad_accum_equals_large_batch(tiny_config, rng_np):
     p1, _, m1 = step(params, opt_state, x1, y1, jax.random.PRNGKey(0), 0)
 
     np.testing.assert_allclose(float(m4.loss), float(m1.loss), rtol=1e-5)
+    # Tolerance: the two paths differ only in fp32 reduction order (scan-of-4
+    # partial sums vs one fused sum).  That ~1e-7-relative gradient noise is
+    # amplified by one AdamW step through g/sqrt(nu) — with nu ~ g^2 at step 0
+    # the update is ~lr*sign(g), so order noise can shift a parameter by
+    # O(lr * eps_machine / |g|) ~ 1e-4 for near-zero gradient entries.
     for a, b in zip(jax.tree_util.tree_leaves(p4), jax.tree_util.tree_leaves(p1)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
 def test_step_determinism(tiny_config, rng_np):
