@@ -29,14 +29,21 @@ def main() -> None:
     p.add_argument("--model", default="124M")
     p.add_argument("--seq_len", type=int, default=1024)
     p.add_argument("--batch", type=int, default=0, help="micro-batch per chip; 0 = auto")
-    p.add_argument("--grad_accum_steps", type=int, default=1)
-    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--grad_accum_steps", type=int, default=0, help="0 = auto")
+    p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument(
-        "--remat", nargs="?", const="block", default=False,
-        choices=["block", "mlp"],
+        "--remat", nargs="?", const="block", default=None,
+        choices=["block", "mlp", "off"],
         help="activation checkpointing ('block' = whole block, 'mlp' = MLP "
-        "sublayer only; bare flag means 'block')",
+        "sublayer only; bare flag means 'block'; 'off' forces none; "
+        "default: off for 124M/345M, 'mlp' for larger presets)",
+    )
+    p.add_argument(
+        "--scan_layers", default="auto", choices=["auto", "on", "off"],
+        help="block stack as one lax.scan ('on') or unrolled ('off'; ~11%% "
+        "faster steps — XLA schedules across layer boundaries only when "
+        "unrolled, see PERF_ANALYSIS.md). 'auto' unrolls 124M/345M.",
     )
     args = p.parse_args()
     args.steps = max(1, args.steps)
@@ -58,19 +65,30 @@ def main() -> None:
     )
     from gpt_2_distributed_tpu.utils.flops import device_peak_flops, flops_per_token, mfu
 
-    config = MODEL_PRESETS[args.model].replace(
-        n_positions=max(args.seq_len, 1024), remat=args.remat
-    )
     n_chips = jax.device_count()
     on_tpu = jax.devices()[0].platform == "tpu"
+    small_model = args.model in ("124M", "345M")
+    # Round-2 swept operating point on a v5e chip (see PERF_ANALYSIS.md):
+    # micro-batch 8, grad-accum 8, NO remat, UNROLLED layers -> 49.2% MFU
+    # (113.5k tok/s/chip); the scan/remat defaults only pay off on the
+    # larger presets where compile time and activations actually demand them.
+    if args.remat is None:
+        remat = False if small_model else "mlp"
+    else:
+        remat = False if args.remat == "off" else args.remat
+    if args.scan_layers == "auto":
+        scan_layers = not small_model
+    else:
+        scan_layers = args.scan_layers == "on"
+    config = MODEL_PRESETS[args.model].replace(
+        n_positions=max(args.seq_len, 1024), remat=remat,
+        scan_layers=scan_layers,
+    )
     if args.batch:
         micro_batch = args.batch
     else:
-        # Dense-attention activation memory caps the micro-batch at 4 on a
-        # 16G-HBM chip (cf. the reference's identical finding on a 32G GPU,
-        # /root/reference/dataloader.py:15-17); the Pallas flash-attention path
-        # lifts this.
-        micro_batch = 4 if on_tpu else 2
+        micro_batch = (8 if small_model else 4) if on_tpu else 2
+    grad_accum = args.grad_accum_steps or (8 if on_tpu else 1)
     seq_len = args.seq_len if on_tpu else min(args.seq_len, 256)
     steps = args.steps if on_tpu else max(2, args.steps // 5)
 
@@ -80,7 +98,7 @@ def main() -> None:
     optimizer = make_optimizer(1e-4)
 
     rng_np = np.random.default_rng(0)
-    shape = (args.grad_accum_steps, micro_batch * n_chips, seq_len)
+    shape = (grad_accum, micro_batch * n_chips, seq_len)
     x = rng_np.integers(0, config.vocab_size, shape, dtype=np.int32)
     y = rng_np.integers(0, config.vocab_size, shape, dtype=np.int32)
 
@@ -106,7 +124,7 @@ def main() -> None:
         final_loss = float(metrics.loss)
         dt = time.perf_counter() - t0
 
-    tokens_per_step = args.grad_accum_steps * micro_batch * n_chips * seq_len
+    tokens_per_step = grad_accum * micro_batch * n_chips * seq_len
     tok_s = tokens_per_step * steps / dt
     tok_s_chip = tok_s / n_chips
     peak = device_peak_flops()
@@ -123,7 +141,7 @@ def main() -> None:
                 "model": args.model,
                 "seq_len": seq_len,
                 "micro_batch_per_chip": micro_batch,
-                "grad_accum": args.grad_accum_steps,
+                "grad_accum": grad_accum,
                 "n_chips": n_chips,
                 "device": jax.devices()[0].device_kind,
                 "flops_per_token": flops_per_token(config, seq_len),

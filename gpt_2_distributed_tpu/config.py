@@ -51,11 +51,30 @@ class GPT2Config:
     # score stripes, in-kernel dropout); "auto" = flash on TPU when the
     # sequence length allows it, dense otherwise.
     attention_impl: str = "auto"
+    # Training-loss path: "blocked" = logit-free chunked CE (ops/losses.py),
+    # O(rows*V) HBM — required for large micro-batches; "dense" = materialize
+    # [B*T, V] fp32 logits and let XLA autodiff (measured slightly faster at
+    # micro-batch <= 8 where the 1.6 GB logits fit — the win is one fewer
+    # logits recompute in backward at the cost of storing them).
+    loss_impl: str = "blocked"
 
     def __post_init__(self) -> None:
         if self.n_embd % self.n_head != 0:
             raise ValueError(
                 f"n_embd={self.n_embd} must be divisible by n_head={self.n_head}"
+            )
+        if self.attention_impl not in ("auto", "dense", "flash"):
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r}: expected "
+                "'auto', 'dense' or 'flash'"
+            )
+        if self.loss_impl not in ("blocked", "dense"):
+            raise ValueError(
+                f"loss_impl={self.loss_impl!r}: expected 'blocked' or 'dense'"
+            )
+        if self.remat not in (False, True, "block", "mlp"):
+            raise ValueError(
+                f"remat={self.remat!r}: expected False, True, 'block' or 'mlp'"
             )
 
     @property

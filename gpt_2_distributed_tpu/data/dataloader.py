@@ -327,13 +327,15 @@ class _WorkerThread(threading.Thread):
 
     def stop(self) -> None:
         self._stop_event.set()
-        # Drain so a blocked put() can observe the stop event.
-        # Bound Empty locally: module globals may already be cleared if a
-        # leaked iterator is finalized at interpreter shutdown.
+        # Drain so a blocked put() can observe the stop event. Best-effort by
+        # construction: when a leaked iterator is finalized at interpreter
+        # shutdown, the queue module's own globals may already be torn down
+        # and get_nowait can raise things that are not Empty (or not even
+        # Exception subclasses) — nothing here is worth propagating.
         try:
             while True:
                 self.queue.get_nowait()
-        except Empty:
+        except BaseException:  # noqa: BLE001 — see comment
             pass
 
 

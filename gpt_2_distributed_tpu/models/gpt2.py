@@ -253,7 +253,7 @@ def forward(
     x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
 
     wte = params["wte"].astype(compute_dtype)
-    if labels is not None and not return_logits:
+    if labels is not None and not return_logits and config.loss_impl == "blocked":
         # Training path: blocked CE over the tied head — no [B,T,V] logits.
         loss = blocked_cross_entropy(
             x.reshape(-1, config.n_embd), wte, labels.reshape(-1)
@@ -267,6 +267,11 @@ def forward(
     loss = None
     if labels is not None:
         loss = cross_entropy(logits, labels)
+    if labels is not None and not return_logits:
+        # Training path with loss_impl="dense": logits are a backward-pass
+        # residual, not an output — dropping them here lets jit DCE the
+        # [B, T, V] fp32 tensor from the step's outputs.
+        return None, loss
     return logits, loss
 
 

@@ -51,14 +51,56 @@ determinism holds per seed per implementation).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30  # causal mask fill for fp32 row-max stability (see docstring)
 DEFAULT_BLOCK_Q = 512  # fastest on v5e at seq 1024 (256/512/1024 swept)
+
+# ---------------------------------------------------------------------------
+# SPMD: Mosaic custom calls cannot be auto-partitioned by GSPMD — jitting this
+# kernel over a >1-device mesh fails to compile ("Mosaic kernels cannot be
+# automatically partitioned. Please wrap the call in a shard_map"), which is
+# exactly how the framework runs it: batch-sharded [B, H, T, D] under the
+# ('data', 'fsdp') mesh. Flash attention is embarrassingly parallel over
+# (batch, head), so when an ambient mesh is active the public entry point
+# wraps the kernel in ``jax.shard_map``: batch dim split over the data-like
+# axes, head dim over the tensor-like axes, T and D resident per device (the
+# causal recurrence runs over the full sequence — sequence parallelism is
+# ring attention's job, not this kernel's). shard_map (rather than
+# custom_partitioning) keeps the program free of Python partitioning
+# callbacks, so ahead-of-time topology compilation (scripts/validate_presets)
+# works. The per-shard kernel re-seeds its dropout hash with the linear shard
+# index — without that, every shard would hash identical local (b, h, row,
+# col) coordinates and reuse the same mask.
+# ---------------------------------------------------------------------------
+
+BATCH_AXIS_NAMES = ("data", "fsdp", "dp", "batch", "replica")
+HEAD_AXIS_NAMES = ("tp", "model", "tensor")
+
+
+def _ambient_mesh():
+    """The `with mesh:` context's physical mesh, or None.
+
+    Read via the thread_resources registry; jax exposes no public accessor
+    for the legacy mesh context manager, so this probes the known homes and
+    degrades to None (unwrapped, single-device semantics) if a future jax
+    moves them — pyproject pins jax<0.10 so the probe list stays valid."""
+    for probe in (
+        lambda: __import__("jax._src.mesh", fromlist=["thread_resources"]),
+        lambda: __import__("jax.interpreters.pxla", fromlist=["thread_resources"]),
+    ):
+        try:
+            m = probe().thread_resources.env.physical_mesh
+        except (ImportError, AttributeError):
+            continue
+        return None if (m.empty or m.size == 1) else m
+    return None
 
 
 def pick_block_q(t: int, preferred: int = DEFAULT_BLOCK_Q) -> int | None:
@@ -261,9 +303,12 @@ def _bwd_kernel(
 
 @functools.lru_cache(maxsize=None)
 def _build(dropout_rate: float, block_q: int, interpret: bool):
-    """Build the custom-VJP flash attention ([B, H, T, D]) for one config."""
+    """Build the custom-VJP flash attention ([B, H, T, D]) for one config.
 
-    def fwd_call(q, k, v, seed):
+    Device-local: callers shard over (batch, head) with ``jax.shard_map``
+    (see ``flash_attention`` and the module SPMD comment)."""
+
+    def _raw_fwd(seed, q, k, v):
         batch, heads, t, d = q.shape
         nq = t // block_q
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -304,21 +349,16 @@ def _build(dropout_rate: float, block_q: int, interpret: bool):
 
     @jax.custom_vjp
     def attn(q, k, v, seed):
-        o, _ = fwd_call(q, k, v, seed)
+        o, _ = _raw_fwd(seed, q, k, v)
         return o
 
     def attn_fwd(q, k, v, seed):
-        o, lse = fwd_call(q, k, v, seed)
+        o, lse = _raw_fwd(seed, q, k, v)
         return o, (q, k, v, seed, o, lse)
 
-    def attn_bwd(res, do):
-        q, k, v, seed, o, lse = res
+    def _raw_bwd(seed, q, k, v, do, lse, delta):
         batch, heads, t, d = q.shape
         nq = t // block_q
-        delta = jnp.sum(
-            do.astype(jnp.float32) * o.astype(jnp.float32),
-            axis=-1, keepdims=True,
-        )                                             # [B, H, T, 1]
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(batch, heads, nq, nq),
@@ -360,6 +400,15 @@ def _build(dropout_rate: float, block_q: int, interpret: bool):
             ],
             interpret=interpret,
         )(seed, q, k, v, do, lse, delta)
+        return dq, dk, dv
+
+    def attn_bwd(res, do):
+        q, k, v, seed, o, lse = res
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )                                             # [B, H, T, 1]
+        dq, dk, dv = _raw_bwd(seed, q, k, v, do, lse, delta)
         return dq, dk.astype(k.dtype), dv.astype(v.dtype), None
 
     attn.defvjp(attn_fwd, attn_bwd)
@@ -397,7 +446,52 @@ def flash_attention(
         seed = jax.random.randint(rng, (1,), 0, jnp.iinfo(jnp.int32).max, jnp.int32)
     else:
         seed = jnp.zeros((1,), jnp.int32)
-    return _build(rate, block_q, interpret)(q, k, v, seed)
+    attn = _build(rate, block_q, interpret)
+
+    mesh = _ambient_mesh()
+    if mesh is not None:
+        # Multi-device mesh active: run the kernel under shard_map, split over
+        # whatever batch-like / head-like axes divide the shapes (see module
+        # SPMD comment). Axes of size 1 are skipped; a non-dividing axis set
+        # falls through to the unwrapped call (single-device semantics).
+        def dividing_axes(names, dim):
+            # Greedy prefix of axes whose product divides `dim`; axes that
+            # don't divide are dropped (that slice of the mesh executes the
+            # kernel replicated rather than hitting Mosaic's unpartitionable
+            # custom-call error with a sharded operand).
+            axes, prod = [], 1
+            for a in mesh.axis_names:
+                if a in names and mesh.shape[a] > 1 and dim % (prod * mesh.shape[a]) == 0:
+                    axes.append(a)
+                    prod *= mesh.shape[a]
+            return tuple(axes)
+
+        b_axes = dividing_axes(BATCH_AXIS_NAMES, q.shape[0])
+        h_axes = dividing_axes(HEAD_AXIS_NAMES, q.shape[1])
+        if b_axes or h_axes:
+            spec = P(b_axes or None, h_axes or None, None, None)
+
+            def _local(q, k, v, seed):
+                if rate > 0.0:
+                    # Distinct dropout streams per shard: the kernel hashes
+                    # LOCAL (b, h, row, col) coordinates, identical on every
+                    # shard — mix the linear shard index into the seed.
+                    idx = jnp.uint32(0)
+                    for a in b_axes + h_axes:
+                        idx = idx * jnp.uint32(mesh.shape[a]) + jax.lax.axis_index(
+                            a).astype(jnp.uint32)
+                    seed = (
+                        seed.astype(jnp.uint32) ^ (idx * jnp.uint32(0x9E3779B1))
+                    ).astype(jnp.int32)
+                return attn(q, k, v, seed)
+
+            return jax.shard_map(
+                _local, mesh=mesh,
+                in_specs=(spec, spec, spec, P(None)),
+                out_specs=spec, check_vma=False,
+            )(q, k, v, seed)
+
+    return attn(q, k, v, seed)
 
 
 def flash_attention_bthd(

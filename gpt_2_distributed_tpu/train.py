@@ -100,6 +100,19 @@ def build_parser() -> argparse.ArgumentParser:
         "bare flag means this) or 'mlp' (remat only the MLP sublayer — "
         "attention runs once; the throughput sweet spot when memory allows)",
     )
+    p.add_argument(
+        "--loss_impl", default="blocked", choices=["blocked", "dense"],
+        help="training loss: 'blocked' logit-free chunked CE (O(rows*V) HBM) "
+        "or 'dense' full-logits XLA autodiff (only viable at small "
+        "micro-batches; see PERF_ANALYSIS.md)",
+    )
+    p.add_argument(
+        "--scan_layers", default="auto", choices=["auto", "on", "off"],
+        help="block stack as one lax.scan ('on': constant-size HLO, fast "
+        "compile — needed for 774M/1.5B) or unrolled ('off': ~11%% faster "
+        "steps, XLA schedules across layer boundaries — see "
+        "PERF_ANALYSIS.md). 'auto' unrolls 124M/345M, scans larger presets.",
+    )
     p.add_argument("--profile", action="store_true", help="jax.profiler trace into --log_dir")
     p.add_argument("--cli_every", type=int, default=20)
     p.add_argument("--tb_every", type=int, default=1)
@@ -150,6 +163,15 @@ def make_lr_schedule(args, steps_per_epoch: int):
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
 
+    # Honor JAX_PLATFORMS even when a site boot hook force-registered a
+    # different backend before us (observed: an attached-TPU hook overriding
+    # JAX_PLATFORMS=cpu, silently moving "CPU" CLI runs onto the TPU chip).
+    # The config update is authoritative where the env var is merely a hint.
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     from gpt_2_distributed_tpu.parallel.mesh import (
         MeshSpec,
         create_mesh,
@@ -181,8 +203,13 @@ def main(argv: list[str] | None = None) -> None:
         for k in ("n_layer", "n_embd", "n_head", "vocab_size")
         if getattr(args, k) is not None
     }
+    if args.scan_layers == "auto":
+        scan_layers = args.model not in ("124M", "345M")
+    else:
+        scan_layers = args.scan_layers == "on"
     config = MODEL_PRESETS[args.model].replace(
-        n_positions=args.seq_len, remat=args.remat, **overrides
+        n_positions=args.seq_len, remat=args.remat, scan_layers=scan_layers,
+        loss_impl=args.loss_impl, **overrides
     )
 
     # --- mesh ---------------------------------------------------------------

@@ -1,0 +1,115 @@
+"""Worker process for the real 2-process multi-host test.
+
+Launched by tests/test_multihost.py with torchrun-style env vars
+(MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK — the contract of the reference's
+launcher, /root/reference/scripts/run_training_distributed_fsdp_main.sh:15-28).
+Each process brings 4 virtual CPU devices, so the pair forms the same 8-device
+global topology the single-process test suite uses — but with every
+``process_count > 1`` branch actually taken:
+
+* ``init_distributed()``'s coordinator path (parallel/mesh.py)
+* ``shard_batch``'s ``jax.make_array_from_process_local_data`` assembly
+  (parallel/sharding.py)
+* ``_default_reduce``'s ``process_allgather`` mean (metrics/tracker.py)
+
+Prints one JSON line with the per-rank observations for the parent to check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+# 4 virtual CPU devices per process, BEFORE jax import.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", ""),
+).strip()
+os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+
+from gpt_2_distributed_tpu.config import GPT2Config  # noqa: E402
+from gpt_2_distributed_tpu.metrics.tracker import _default_reduce  # noqa: E402
+from gpt_2_distributed_tpu.models import gpt2  # noqa: E402
+from gpt_2_distributed_tpu.parallel.mesh import (  # noqa: E402
+    MeshSpec,
+    create_mesh,
+    init_distributed,
+    is_primary,
+)
+from gpt_2_distributed_tpu.parallel.sharding import (  # noqa: E402
+    shard_batch,
+    shard_params_and_opt_state,
+)
+from gpt_2_distributed_tpu.parallel.train_step import (  # noqa: E402
+    make_optimizer,
+    make_train_step,
+)
+
+
+def main() -> None:
+    # Exercises the env-var contract: MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK.
+    init_distributed()
+    assert jax.process_count() == 2, f"process_count={jax.process_count()}"
+    assert jax.device_count() == 8, f"global devices={jax.device_count()}"
+    assert len(jax.local_devices()) == 4
+
+    rank = jax.process_index()
+    config = GPT2Config(
+        vocab_size=257, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+        embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
+    )
+
+    # Hybrid 2x4 mesh over the 8 global devices: the 'data' axis spans the two
+    # processes, 'fsdp' spans each process's local devices.
+    mesh = create_mesh(MeshSpec(data=2, fsdp=4))
+
+    # Global batch [accum=1, B=8, T=32]; each process feeds its HALF (the rows
+    # its devices own) — mirroring the dataloader's per-process slice.
+    rng = np.random.default_rng(1234)
+    x_global = rng.integers(0, config.vocab_size, (1, 8, 32), dtype=np.int32)
+    y_global = rng.integers(0, config.vocab_size, (1, 8, 32), dtype=np.int32)
+    lo, hi = (0, 4) if rank == 0 else (4, 8)
+    x_local, y_local = x_global[:, lo:hi], y_global[:, lo:hi]
+
+    params = gpt2.init_params(config)
+    optimizer = make_optimizer(1e-3)
+    with mesh:
+        params, opt_state, _, _ = shard_params_and_opt_state(
+            params, optimizer, mesh
+        )
+        # multi-host branch: make_array_from_process_local_data
+        xs, ys = shard_batch((x_local, y_local), mesh)
+        assert xs.shape == (1, 8, 32), f"global batch shape {xs.shape}"
+        step = make_train_step(config, optimizer)
+        key = jax.random.PRNGKey(0)
+        params, opt_state, metrics = step(params, opt_state, xs, ys, key, 0)
+        loss = float(metrics.loss)
+        grad_norm = float(metrics.grad_norm)
+
+    # multi-host branch: process_allgather mean over per-rank values.
+    reduced = _default_reduce({"val": float(rank * 10 + 1), "const": 7.0})
+
+    print(json.dumps({
+        "rank": rank,
+        "is_primary": is_primary(),
+        "loss": loss,
+        "grad_norm": grad_norm,
+        "reduced_val": reduced["val"],
+        "reduced_const": reduced["const"],
+    }))
+    sys.stdout.flush()
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Statistical vetting of ``hash_random_bits`` — the model-layer dropout RNG.
+
+Round-1 VERDICT weak-point #6: the threefry replacement (ops/layers.py) was
+only exercised inside the flash kernel; its model-wide use (every dropout
+site, ``ops/layers.dropout``) shipped without a distribution test, and the
+pre-finalizer mix is linear in the iotas (XOR of per-dim products), which
+could in principle create structured collisions. These tests pin:
+
+* uniformity (chi-square over the top byte),
+* collision count at the 32-bit birthday bound (structured collisions in the
+  linear mix would blow this up by orders of magnitude),
+* keep-rate accuracy and per-row binomial variance (no striping),
+* adjacent-position and cross-key independence.
+
+All thresholds are ~5x looser than the measured values on seeds 0..3, so the
+tests guard against regressions in the hash, not sampling noise.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from gpt_2_distributed_tpu.ops.layers import dropout, hash_random_bits
+
+RATE = 0.1
+THRESH = np.uint32(int(RATE * 2**32))
+
+
+@pytest.mark.parametrize("seed,shape", [
+    (0, (1024, 3072)),
+    (1, (8, 1024, 768)),
+    (2, (512, 512)),
+])
+def test_bits_uniform_and_collision_free(seed, shape):
+    bits = np.asarray(hash_random_bits(jax.random.PRNGKey(seed), shape)).ravel()
+    n = bits.size
+
+    # Collisions at the 32-bit birthday bound: E[unique] = 2^32(1-e^{-n/2^32}).
+    # A structured linear-mix collision family would collapse uniqueness far
+    # below this; allow 3x the expected collision count.
+    expected_unique = 2**32 * (1 - np.exp(-n / 2**32))
+    expected_collisions = n - expected_unique
+    actual_collisions = n - np.unique(bits).size
+    assert actual_collisions < 3 * expected_collisions + 100, (
+        f"{actual_collisions} collisions vs birthday-bound "
+        f"{expected_collisions:.0f}"
+    )
+
+    # Chi-square over the top byte: 255 dof, mean 255, std ~22.6. Measured
+    # 251-279 across seeds; 500 is a >10-sigma regression guard.
+    hist = np.bincount(bits >> 24, minlength=256)
+    chi2 = ((hist - n / 256) ** 2 / (n / 256)).sum()
+    assert chi2 < 500, f"chi2={chi2:.0f} (dof=255)"
+
+    # Adjacent-position correlation (the XOR-of-products mix is per-position;
+    # neighboring iotas must not leak through the finalizer).
+    u = bits.astype(np.float64) / 2**32
+    corr = np.corrcoef(u[:-1], u[1:])[0, 1]
+    assert abs(corr) < 0.01, f"adjacent corr={corr:.4f}"
+
+
+def test_keep_rate_and_row_variance():
+    bits = np.asarray(hash_random_bits(jax.random.PRNGKey(3), (4096, 1024)))
+    keep = bits >= THRESH
+
+    # Global keep rate within 5 sigma of 1-rate.
+    n = keep.size
+    sigma = np.sqrt(RATE * (1 - RATE) / n)
+    assert abs(keep.mean() - (1 - RATE)) < 5 * sigma
+
+    # Per-row keep rates must look binomial — striping along either axis
+    # (e.g. a weak per-dim prime) would inflate the row variance.
+    row_std = keep.mean(axis=1).std()
+    binom_std = np.sqrt(RATE * (1 - RATE) / 1024)
+    assert row_std < 1.5 * binom_std
+    col_std = keep.mean(axis=0).std()
+    binom_std_c = np.sqrt(RATE * (1 - RATE) / 4096)
+    assert col_std < 1.5 * binom_std_c
+
+
+def test_cross_key_independence():
+    shape = (1024, 1024)
+    m1 = np.asarray(hash_random_bits(jax.random.PRNGKey(11), shape)) < THRESH
+    m2 = np.asarray(hash_random_bits(jax.random.PRNGKey(12), shape)) < THRESH
+    # Independent masks drop-overlap at rate^2 = 1%; bound at 1.5%.
+    overlap = (m1 & m2).mean()
+    assert overlap < 1.5 * RATE * RATE + 1e-3, f"overlap={overlap:.4f}"
+    # And the masks themselves differ.
+    assert (m1 != m2).mean() > 0.1
+
+
+def test_dropout_layer_mean_preserving():
+    """End-to-end through ops.layers.dropout: inverted scaling keeps E[x]."""
+    x = np.ones((2048, 512), np.float32)
+    out = np.asarray(
+        dropout(x, RATE, jax.random.PRNGKey(7), deterministic=False)
+    )
+    kept = out != 0.0
+    np.testing.assert_allclose(kept.mean(), 1 - RATE, atol=5e-3)
+    np.testing.assert_allclose(out[kept], 1.0 / (1 - RATE), rtol=1e-6)
+    np.testing.assert_allclose(out.mean(), 1.0, atol=5e-3)

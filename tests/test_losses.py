@@ -86,3 +86,39 @@ def test_forward_training_path_matches_logits_path(tiny_config, rng_np):
     )
     assert none_logits is None and logits is not None
     np.testing.assert_allclose(float(loss_blocked), float(loss_dense), rtol=1e-6)
+
+
+def test_loss_impl_dense_config_path(tiny_config, rng_np):
+    """config.loss_impl='dense' trains on full logits with DCE'd outputs:
+    same loss as the blocked path, logits still not returned."""
+    from gpt_2_distributed_tpu.models import gpt2
+
+    params = gpt2.init_params(tiny_config)
+    x = jnp.asarray(
+        rng_np.integers(0, tiny_config.vocab_size, (2, 32)), jnp.int32
+    )
+    y = jnp.asarray(
+        rng_np.integers(0, tiny_config.vocab_size, (2, 32)), jnp.int32
+    )
+    logits_d, loss_dense = gpt2.forward(
+        params, tiny_config.replace(loss_impl="dense"), x, labels=y,
+        compute_dtype=jnp.float32,
+    )
+    _, loss_blocked = gpt2.forward(
+        params, tiny_config, x, labels=y, compute_dtype=jnp.float32
+    )
+    assert logits_d is None  # training path must not emit [B,T,V] outputs
+    np.testing.assert_allclose(float(loss_dense), float(loss_blocked), rtol=1e-6)
+
+
+def test_config_validates_impl_choices():
+    import pytest
+
+    from gpt_2_distributed_tpu.config import GPT2Config
+
+    with pytest.raises(ValueError, match="loss_impl"):
+        GPT2Config(loss_impl="Blocked")
+    with pytest.raises(ValueError, match="attention_impl"):
+        GPT2Config(attention_impl="flashy")
+    with pytest.raises(ValueError, match="remat"):
+        GPT2Config(remat="attention")

@@ -1,0 +1,128 @@
+"""Real 2-process multi-host test (round-1 VERDICT next-step #3).
+
+Every ``jax.process_count() > 1`` branch in the framework — the coordinator
+bootstrap, ``shard_batch``'s process-local assembly, and the tracker's
+``process_allgather`` reduce — runs single-process in the rest of the suite.
+Here two REAL processes (4 virtual CPU devices each) rendezvous through
+``jax.distributed`` on a local coordinator and execute one hybrid-mesh train
+step, proving the multi-host code paths execute and agree with the
+single-process ground truth.
+
+Launch contract matches the reference's torchrun scripts
+(/root/reference/scripts/run_training_distributed_fsdp_main.sh:15-28):
+MASTER_ADDR, MASTER_PORT, WORLD_SIZE, RANK env vars only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO_ROOT, "tests", "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def worker_results():
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(port),
+        "WORLD_SIZE": "2",
+        "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER],
+            env={**env_base, "RANK": str(rank)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host worker timed out (rendezvous deadlock?)")
+        assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return sorted(outs, key=lambda r: r["rank"])
+
+
+def test_two_processes_rendezvous_and_agree(worker_results):
+    r0, r1 = worker_results
+    assert r0["rank"] == 0 and r1["rank"] == 1
+    assert r0["is_primary"] and not r1["is_primary"]
+    # The jitted step's outputs are global scalars — identical on every host.
+    assert r0["loss"] == pytest.approx(r1["loss"], rel=1e-6)
+    assert r0["grad_norm"] == pytest.approx(r1["grad_norm"], rel=1e-6)
+
+
+def test_multihost_loss_matches_single_process(worker_results):
+    """The 2-process hybrid-mesh step must equal the same step computed
+    single-process on the same global batch (the suite's 8 virtual devices)."""
+    import jax
+
+    from gpt_2_distributed_tpu.config import GPT2Config
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, create_mesh
+    from gpt_2_distributed_tpu.parallel.sharding import (
+        shard_batch,
+        shard_params_and_opt_state,
+    )
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    config = GPT2Config(
+        vocab_size=257, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+        embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
+    )
+    rng = np.random.default_rng(1234)  # same stream as the worker
+    x = rng.integers(0, config.vocab_size, (1, 8, 32), dtype=np.int32)
+    y = rng.integers(0, config.vocab_size, (1, 8, 32), dtype=np.int32)
+
+    params = gpt2.init_params(config)
+    optimizer = make_optimizer(1e-3)
+    mesh = create_mesh(MeshSpec(data=2, fsdp=4))
+    with mesh:
+        params, opt_state, _, _ = shard_params_and_opt_state(
+            params, optimizer, mesh
+        )
+        xs, ys = shard_batch((x, y), mesh)
+        step = make_train_step(config, optimizer)
+        _, _, metrics = step(params, opt_state, xs, ys, jax.random.PRNGKey(0), 0)
+        expected_loss = float(metrics.loss)
+        expected_gn = float(metrics.grad_norm)
+
+    r0, _ = worker_results
+    assert r0["loss"] == pytest.approx(expected_loss, rel=2e-5)
+    assert r0["grad_norm"] == pytest.approx(expected_gn, rel=2e-4)
+
+
+def test_tracker_reduce_is_cross_process_mean(worker_results):
+    r0, r1 = worker_results
+    # per-rank inputs were rank*10 + 1 -> mean of {1, 11} = 6.0
+    assert r0["reduced_val"] == pytest.approx(6.0)
+    assert r1["reduced_val"] == pytest.approx(6.0)
+    # a value equal on all ranks reduces to itself
+    assert r0["reduced_const"] == pytest.approx(7.0)
