@@ -35,6 +35,8 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+SP_AXIS = "sp"    # sequence/context parallel (ring attention)
+TP_AXIS = "tp"    # tensor (Megatron) parallel
 
 TRAINING_MODES = ("local", "dp", "ddp", "fsdp")
 
@@ -93,18 +95,25 @@ def is_primary() -> bool:
 
 @dataclass(frozen=True)
 class MeshSpec:
-    """Mesh shape: data-parallel degree x param-shard (fsdp) degree."""
+    """Mesh shape: data x fsdp x sp x tp parallel degrees.
+
+    ``data``/``fsdp`` reproduce the reference's modes (SURVEY.md §2.2);
+    ``sp`` (sequence/ring attention) and ``tp`` (Megatron tensor parallel)
+    are beyond-reference axes — both default to 1 and cost nothing when
+    unused (the mesh always carries all four named axes)."""
 
     data: int = 1
     fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.data * self.fsdp
+        return self.data * self.fsdp * self.sp * self.tp
 
     @classmethod
     def parse(cls, text: str) -> "MeshSpec":
-        """Parse ``"data=2,fsdp=4"`` (either key optional)."""
+        """Parse ``"data=2,fsdp=4"`` / ``"fsdp=2,tp=2,sp=2"`` (keys optional)."""
         kwargs = {}
         for part in text.split(","):
             if not part.strip():
@@ -127,16 +136,19 @@ class MeshSpec:
 
 
 def create_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
-    """A 2-D ('data', 'fsdp') mesh over the first data*fsdp devices.
+    """A 4-D ('data', 'fsdp', 'sp', 'tp') mesh over the first n devices.
 
     Device order follows ``jax.devices()``, which JAX arranges so that
-    adjacent devices are ICI neighbors — the trailing ('fsdp') axis therefore
-    gets the fastest links, which is where the per-block all-gathers live.
+    adjacent devices are ICI neighbors — trailing axes get the fastest
+    links. Ordering rationale: 'tp' innermost (per-layer all-reduces, the
+    chattiest), then 'sp' (ring permutes), then 'fsdp' (per-block
+    all-gathers), with 'data' outermost (one gradient reduction per step —
+    the axis that can afford DCN).
     """
     if devices is None:
         devices = jax.devices()
     n = spec.n_devices
     if n > len(devices):
         raise ValueError(f"mesh {spec} needs {n} devices, have {len(devices)}")
-    grid = np.asarray(devices[:n]).reshape(spec.data, spec.fsdp)
-    return Mesh(grid, (DATA_AXIS, FSDP_AXIS))
+    grid = np.asarray(devices[:n]).reshape(spec.data, spec.fsdp, spec.sp, spec.tp)
+    return Mesh(grid, (DATA_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS))

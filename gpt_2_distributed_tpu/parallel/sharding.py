@@ -24,38 +24,69 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gpt_2_distributed_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS
+from gpt_2_distributed_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+)
+
+# Megatron-style tensor parallelism as pure PartitionSpecs: the MLP up-proj
+# is column- (output-dim-) sharded, the attention out-proj and MLP down-proj
+# are row- (input-dim-) sharded, so each block costs exactly one psum over
+# 'tp' per sublayer (GSPMD inserts it from the partial-sum matmuls). The
+# fused qkv weight stays REPLICATED across 'tp': its [C, 3C] q|k|v layout
+# (reference parity, model.py:95) is not block-aligned for contiguous-dim
+# sharding — the attention heads are instead sharded over 'tp' at the kernel
+# boundary (flash_attention's shard_map head axes / GSPMD head-dim
+# propagation), which re-parallelizes everything downstream of the qkv
+# matmul. Cost: 3C^2 of the 12C^2 per-layer matmul flops run replicated.
+_TP_ROW_LEAVES = {"attn_proj_w", "mlp_proj_w"}   # shard input (row) dim
+_TP_COL_LEAVES = {"mlp_fc_w", "mlp_fc_b"}        # shard output (col) dim
 
 
-def _leaf_pspec(path: tuple, leaf: Any, fsdp_size: int) -> P:
-    """PartitionSpec for one parameter leaf under the 'fsdp' axis."""
-    if fsdp_size <= 1:
-        return P()  # replicated (pure DP / local)
+def _leaf_pspec(path: tuple, leaf: Any, fsdp_size: int, tp_size: int = 1) -> P:
+    """PartitionSpec for one parameter leaf under the 'fsdp' + 'tp' axes."""
     shape = np.shape(leaf)
     if len(shape) == 0:
         return P()
     # Stacked per-layer leaves live under the "block" subtree; their axis 0 is
     # the layer axis and must stay unsharded (see module docstring).
     is_block = any(getattr(k, "key", None) == "block" for k in path)
-    candidate_dims = range(len(shape) - 1, 0 if is_block else -1, -1)
-    best_dim = None
-    for d in candidate_dims:
-        if shape[d] % fsdp_size == 0:
-            if best_dim is None or shape[d] > shape[best_dim]:
-                best_dim = d
-    if best_dim is None:
-        return P()
+    leaf_name = next(
+        (getattr(k, "key", None) for k in reversed(path)
+         if getattr(k, "key", None)), None,
+    )
+
     spec: list = [None] * len(shape)
-    spec[best_dim] = FSDP_AXIS
+    if tp_size > 1 and is_block:
+        # Row/col dims counted after the leading layer axis.
+        if leaf_name in _TP_ROW_LEAVES and shape[1] % tp_size == 0:
+            spec[1] = TP_AXIS
+        elif leaf_name in _TP_COL_LEAVES and shape[-1] % tp_size == 0:
+            spec[-1] = TP_AXIS
+
+    if fsdp_size > 1:
+        candidate_dims = range(len(shape) - 1, 0 if is_block else -1, -1)
+        best_dim = None
+        for d in candidate_dims:
+            if spec[d] is None and shape[d] % fsdp_size == 0:
+                if best_dim is None or shape[d] > shape[best_dim]:
+                    best_dim = d
+        if best_dim is not None:
+            spec[best_dim] = FSDP_AXIS
+    if all(s is None for s in spec):
+        return P()
     return P(*spec)
 
 
 def param_pspecs(params: Any, mesh: Mesh) -> Any:
     """PartitionSpec pytree for params (and, by structure, any like-shaped
     tree such as optimizer moments)."""
-    fsdp_size = mesh.shape[FSDP_AXIS]
+    fsdp_size = mesh.shape[FSDP_AXIS] if FSDP_AXIS in mesh.axis_names else 1
+    tp_size = mesh.shape[TP_AXIS] if TP_AXIS in mesh.axis_names else 1
     return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: _leaf_pspec(path, leaf, fsdp_size), params
+        lambda path, leaf: _leaf_pspec(path, leaf, fsdp_size, tp_size), params
     )
 
 

@@ -58,7 +58,7 @@ class TestMeshSpec:
 
     def test_create_mesh_shape(self):
         mesh = create_mesh(MeshSpec(2, 4))
-        assert mesh.shape == {DATA_AXIS: 2, FSDP_AXIS: 4}
+        assert dict(mesh.shape) == {DATA_AXIS: 2, FSDP_AXIS: 4, "sp": 1, "tp": 1}
         with pytest.raises(ValueError):
             create_mesh(MeshSpec(4, 4))
 
@@ -159,3 +159,77 @@ def test_mode_equivalence(tiny_config, spec):
     assert all(np.isfinite(base))
     assert base[-1] < base[0], "loss did not descend"
     np.testing.assert_allclose(test, base, rtol=0, atol=2e-4)
+
+
+def test_tensor_parallel_matches_local(tiny_config, rng_np):
+    """Megatron TP as PartitionSpecs (beyond the reference, SURVEY.md §2.2
+    'trivially expressible later' note): a (data=2, fsdp=2, tp=2) mesh must
+    produce the same loss and updated params as single-device execution —
+    row/col-sharded projections introduce exactly one psum per sublayer and
+    no numerics change in fp32."""
+    import jax
+    import jax.numpy as jnp
+
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, create_mesh
+    from gpt_2_distributed_tpu.parallel.sharding import (
+        param_pspecs,
+        shard_batch,
+        shard_params_and_opt_state,
+    )
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    cfg = tiny_config
+    x = rng_np.integers(0, cfg.vocab_size, (1, 8, 32)).astype("int32")
+    y = rng_np.integers(0, cfg.vocab_size, (1, 8, 32)).astype("int32")
+
+    def run(spec):
+        params = gpt2.init_params(cfg)
+        opt = make_optimizer(1e-3)
+        step = make_train_step(cfg, opt, compute_dtype=jnp.float32, donate=False)
+        mesh = create_mesh(spec)
+        with mesh:
+            params, opt_state, _, _ = shard_params_and_opt_state(params, opt, mesh)
+            xb, yb = shard_batch((x, y), mesh)
+            new_params, _, m = step(params, opt_state, xb, yb,
+                                    jax.random.PRNGKey(0), 0)
+            return float(m.loss), jax.device_get(new_params)
+
+    loss_local, p_local = run(MeshSpec(1, 1, 1, 1))
+    loss_tp, p_tp = run(MeshSpec(data=2, fsdp=2, sp=1, tp=2))
+    assert loss_tp == pytest.approx(loss_local, rel=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=2e-5),
+        p_local, p_tp,
+    )
+
+
+def test_tp_param_specs_shard_expected_leaves(tiny_config):
+    """The TP rule must hit exactly the row/col projection leaves and leave
+    the fused qkv replicated across 'tp' (its q|k|v layout is not
+    block-aligned — see parallel/sharding.py)."""
+    import jax
+
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, create_mesh
+    from gpt_2_distributed_tpu.parallel.sharding import param_pspecs
+
+    params = gpt2.init_params(tiny_config)
+    mesh = create_mesh(MeshSpec(data=1, fsdp=2, sp=1, tp=2))
+    specs = param_pspecs(params, mesh)
+    block = specs["block"]
+    assert block["attn_proj_w"][1] == "tp"
+    assert block["mlp_proj_w"][1] == "tp"
+    assert block["mlp_fc_w"][-1] == "tp"
+    assert block["mlp_fc_b"][-1] == "tp"
+    assert "tp" not in tuple(block["attn_qkv_w"])
+    # fsdp must land on a different dim than tp
+    for name in ("attn_proj_w", "mlp_proj_w", "mlp_fc_w"):
+        s = tuple(block[name])
+        assert s.count("tp") == 1 and s.count("fsdp") <= 1
+        if "fsdp" in s:
+            assert s.index("fsdp") != s.index("tp")
