@@ -54,7 +54,7 @@ def main() -> None:
 
     from gpt_2_distributed_tpu.config import MODEL_PRESETS
     from gpt_2_distributed_tpu.models import gpt2
-    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, create_mesh
+    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, activate_mesh, create_mesh
     from gpt_2_distributed_tpu.parallel.sharding import (
         shard_batch,
         shard_params_and_opt_state,
@@ -102,7 +102,7 @@ def main() -> None:
     x = rng_np.integers(0, config.vocab_size, shape, dtype=np.int32)
     y = rng_np.integers(0, config.vocab_size, shape, dtype=np.int32)
 
-    with mesh:
+    with activate_mesh(mesh):
         params, opt_state, _, _ = shard_params_and_opt_state(params, optimizer, mesh)
         step = make_train_step(config, optimizer)
         x, y = shard_batch((x, y), mesh)

@@ -325,8 +325,13 @@ class _WorkerThread(threading.Thread):
             except Full:
                 continue
 
-    def stop(self) -> None:
+    def signal_stop(self) -> None:
+        """Set the stop event only — non-blocking, safe to call for every
+        worker before any (interruptible) queue drain begins."""
         self._stop_event.set()
+
+    def stop(self) -> None:
+        self.signal_stop()
         # Drain so a blocked put() can observe the stop event. Best-effort by
         # construction: when a leaked iterator is finalized at interpreter
         # shutdown, the queue module's own globals may already be torn down
@@ -335,6 +340,10 @@ class _WorkerThread(threading.Thread):
         try:
             while True:
                 self.queue.get_nowait()
+        except (KeyboardInterrupt, SystemExit):
+            # An ordinary in-process interrupt must still interrupt — the stop
+            # event is already set, so workers will wind down regardless.
+            raise
         except BaseException:  # noqa: BLE001 — see comment
             pass
 
@@ -406,6 +415,12 @@ class DataLoader:
                 batch = item.astype(np.int32)
                 yield batch[:, :-1], batch[:, 1:]
         finally:
+            # Signal every worker BEFORE any (interruptible) queue drain: if a
+            # re-raised KeyboardInterrupt aborts the drain loop below on
+            # worker k, workers k+1.. have still observed their stop events
+            # and wind down instead of spinning in _put() forever.
+            for w in workers:
+                w.signal_stop()
             for w in workers:
                 w.stop()
 

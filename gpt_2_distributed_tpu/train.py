@@ -113,6 +113,12 @@ def build_parser() -> argparse.ArgumentParser:
         "steps, XLA schedules across layer boundaries — see "
         "PERF_ANALYSIS.md). 'auto' unrolls 124M/345M, scans larger presets.",
     )
+    p.add_argument(
+        "--device", default=None, choices=["tpu", "cpu", "gpu"],
+        help="JAX platform to run on (parity with the reference's --device, "
+        "/root/reference/train_gpt2_distributed.py:292-294); overrides the "
+        "JAX_PLATFORMS env var; default = JAX's own platform selection",
+    )
     p.add_argument("--profile", action="store_true", help="jax.profiler trace into --log_dir")
     p.add_argument("--cli_every", type=int, default=20)
     p.add_argument("--tb_every", type=int, default=1)
@@ -163,17 +169,20 @@ def make_lr_schedule(args, steps_per_epoch: int):
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
 
-    # Honor JAX_PLATFORMS even when a site boot hook force-registered a
-    # different backend before us (observed: an attached-TPU hook overriding
-    # JAX_PLATFORMS=cpu, silently moving "CPU" CLI runs onto the TPU chip).
-    # The config update is authoritative where the env var is merely a hint.
-    if os.environ.get("JAX_PLATFORMS"):
+    # Honor --device (highest priority) then JAX_PLATFORMS, even when a site
+    # boot hook force-registered a different backend before us (observed: an
+    # attached-TPU hook overriding JAX_PLATFORMS=cpu, silently moving "CPU"
+    # CLI runs onto the TPU chip). The config update is authoritative where
+    # the env var is merely a hint.
+    platform = args.device or os.environ.get("JAX_PLATFORMS")
+    if platform:
         import jax
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        jax.config.update("jax_platforms", platform)
 
     from gpt_2_distributed_tpu.parallel.mesh import (
         MeshSpec,
+        activate_mesh,
         create_mesh,
         init_distributed,
         is_primary,
@@ -249,7 +258,7 @@ def main(argv: list[str] | None = None) -> None:
     optimizer = make_optimizer(schedule, weight_decay=args.weight_decay)
     params = gpt2.init_params(config, seed=args.seed)
 
-    with mesh:
+    with activate_mesh(mesh):
         params, opt_state, param_shardings, opt_shardings = (
             shard_params_and_opt_state(params, optimizer, mesh)
         )

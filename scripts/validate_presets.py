@@ -52,7 +52,7 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat):
     from gpt_2_distributed_tpu.config import MODEL_PRESETS
     from gpt_2_distributed_tpu.models import gpt2
     from gpt_2_distributed_tpu.parallel import sharding as sh
-    from gpt_2_distributed_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS
+    from gpt_2_distributed_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, activate_mesh
     from gpt_2_distributed_tpu.parallel.train_step import (
         make_optimizer,
         make_train_step,
@@ -87,7 +87,7 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat):
         "n_params": n_params,
     }
     try:
-        with mesh:
+        with activate_mesh(mesh):
             compiled = step.lower(
                 p_in, o_in, x_in, x_in,
                 jax.ShapeDtypeStruct((2,), jnp.uint32), 0,
@@ -132,7 +132,7 @@ def main():
         "v4 = 32 GiB has 2x headroom.\n",
         "| preset | params | topology | mesh (data,fsdp) | micro-batch/chip "
         "| accum | remat | args GiB | temps GiB | peak GiB/chip | fits |",
-        "|---|---|---|---|---|---|---|---|---|---|---|"[:-5] + "|",
+        "|" + "---|" * 11,
     ]
     for r in rows:
         lines.append(

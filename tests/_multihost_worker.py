@@ -42,6 +42,7 @@ from gpt_2_distributed_tpu.metrics.tracker import _default_reduce  # noqa: E402
 from gpt_2_distributed_tpu.models import gpt2  # noqa: E402
 from gpt_2_distributed_tpu.parallel.mesh import (  # noqa: E402
     MeshSpec,
+    activate_mesh,
     create_mesh,
     init_distributed,
     is_primary,
@@ -83,7 +84,7 @@ def main() -> None:
 
     params = gpt2.init_params(config)
     optimizer = make_optimizer(1e-3)
-    with mesh:
+    with activate_mesh(mesh):
         params, opt_state, _, _ = shard_params_and_opt_state(
             params, optimizer, mesh
         )

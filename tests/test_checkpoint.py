@@ -11,7 +11,7 @@ import pytest
 
 from gpt_2_distributed_tpu import checkpoint as ckpt
 from gpt_2_distributed_tpu.models import gpt2
-from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, create_mesh
+from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, activate_mesh, create_mesh
 from gpt_2_distributed_tpu.parallel.sharding import (
     opt_state_shardings,
     shard_batch,
@@ -78,7 +78,7 @@ def test_sharded_restore_onto_mesh(tmp_path, tiny_config):
     values both round-trip."""
     optimizer = make_optimizer(1e-3)
     mesh = create_mesh(MeshSpec(1, 8))
-    with mesh:
+    with activate_mesh(mesh):
         params = gpt2.init_params(tiny_config)
         params, opt_state, shardings, opt_shardings = shard_params_and_opt_state(
             params, optimizer, mesh

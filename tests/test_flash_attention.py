@@ -228,12 +228,12 @@ def test_sharded_over_mesh_matches_dense():
     Runs batch sharded over the suite's 8 virtual CPU devices."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, create_mesh
+    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, activate_mesh, create_mesh
 
     q, k, v = make_qkv(B=8, H=2, T=256, D=64, seed=3)
     mesh = create_mesh(MeshSpec(data=2, fsdp=4))
     o_dense = causal_attention(q, k, v)
-    with mesh:
+    with activate_mesh(mesh):
         sharding = NamedSharding(mesh, P(("data", "fsdp"), None, None, None))
         qs, ks, vs = (jax.device_put(a, sharding) for a in (q, k, v))
         o_f = jax.jit(
@@ -249,14 +249,14 @@ def test_sharded_dropout_streams_differ_per_shard():
     signal."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, create_mesh
+    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, activate_mesh, create_mesh
 
     B, H, T, D = 8, 2, 256, 64
     q = jnp.ones((B, H, T, D), jnp.float32)
     k, v = q, jnp.asarray(
         np.random.default_rng(0).normal(size=(B, H, T, D)), jnp.float32)
     mesh = create_mesh(MeshSpec(data=8, fsdp=1))
-    with mesh:
+    with activate_mesh(mesh):
         sharding = NamedSharding(mesh, P("data", None, None, None))
         qs, ks, vs = (jax.device_put(a, sharding) for a in (q, k, v))
         out = jax.jit(lambda a, b, c: flash_attention(

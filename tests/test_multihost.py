@@ -83,7 +83,7 @@ def test_multihost_loss_matches_single_process(worker_results):
 
     from gpt_2_distributed_tpu.config import GPT2Config
     from gpt_2_distributed_tpu.models import gpt2
-    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, create_mesh
+    from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, activate_mesh, create_mesh
     from gpt_2_distributed_tpu.parallel.sharding import (
         shard_batch,
         shard_params_and_opt_state,
@@ -104,7 +104,7 @@ def test_multihost_loss_matches_single_process(worker_results):
     params = gpt2.init_params(config)
     optimizer = make_optimizer(1e-3)
     mesh = create_mesh(MeshSpec(data=2, fsdp=4))
-    with mesh:
+    with activate_mesh(mesh):
         params, opt_state, _, _ = shard_params_and_opt_state(
             params, optimizer, mesh
         )

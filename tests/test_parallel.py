@@ -23,6 +23,7 @@ from gpt_2_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
     FSDP_AXIS,
     MeshSpec,
+    activate_mesh,
     create_mesh,
     init_distributed,
 )
@@ -101,7 +102,7 @@ def test_fsdp_params_actually_sharded(tiny_config):
     params = gpt2.init_params(tiny_config)
     optimizer = make_optimizer(1e-3)
     mesh = create_mesh(MeshSpec(1, 8))
-    with mesh:
+    with activate_mesh(mesh):
         params, opt_state, _, _ = shard_params_and_opt_state(params, optimizer, mesh)
     w = params["block"]["mlp_fc_w"]  # [L, C, 4C] = [2, 32, 128]
     # Each device holds 1/8 of the leaf.
@@ -115,7 +116,7 @@ def test_fsdp_params_actually_sharded(tiny_config):
 def test_shard_batch_splits_batch_axis():
     mesh = create_mesh(MeshSpec(2, 4))
     x = np.arange(2 * 8 * 4, dtype=np.int32).reshape(2, 8, 4)
-    with mesh:
+    with activate_mesh(mesh):
         xs = shard_batch((x, x), mesh)
     xb = xs[0]
     assert xb.shape == (2, 8, 4)
@@ -142,7 +143,7 @@ def test_mode_equivalence(tiny_config, spec):
         optimizer = make_optimizer(1e-3)
         mesh = create_mesh(mesh_spec)
         losses = []
-        with mesh:
+        with activate_mesh(mesh):
             params, opt_state, _, _ = shard_params_and_opt_state(
                 params, optimizer, mesh
             )
@@ -191,7 +192,7 @@ def test_tensor_parallel_matches_local(tiny_config, rng_np):
         opt = make_optimizer(1e-3)
         step = make_train_step(cfg, opt, compute_dtype=jnp.float32, donate=False)
         mesh = create_mesh(spec)
-        with mesh:
+        with activate_mesh(mesh):
             params, opt_state, _, _ = shard_params_and_opt_state(params, opt, mesh)
             xb, yb = shard_batch((x, y), mesh)
             new_params, _, m = step(params, opt_state, xb, yb,

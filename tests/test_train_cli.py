@@ -116,6 +116,36 @@ def test_cli_eval_every(capsys, shard_dir, tmp_path):
     assert all(e > 0 for e in evals)
 
 
+def test_cli_device_flag(shard_dir):
+    """--device pins the JAX platform (reference CLI parity,
+    /root/reference/train_gpt2_distributed.py:292-294).
+
+    Runs in a subprocess with JAX_PLATFORMS *unset*, so on a machine whose
+    boot hook registers an attached TPU the flag must actively override the
+    default backend — in-process the conftest has already pinned cpu and the
+    assertion would be vacuous."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "gpt_2_distributed_tpu.train",
+         "--data_dir", shard_dir,
+         "--device", "cpu",
+         "--n_layer", "1", "--n_embd", "32", "--n_head", "2",
+         "--vocab_size", "257", "--seq_len", "32", "--batch", "4",
+         "--grad_accum_steps", "1", "--max_steps", "2", "--cli_every", "1"],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "platform: cpu" in out.stdout, out.stdout
+    assert "training done: 2 optimizer steps" in out.stdout
+
+
 def test_cli_explicit_mesh(capsys, shard_dir):
     out = run_cli(
         capsys,
