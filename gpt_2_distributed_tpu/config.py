@@ -48,7 +48,9 @@ class GPT2Config:
     scan_layers: bool = True       # stacked-layer params + lax.scan over blocks
     # Attention kernel: "dense" = XLA O(T^2) parity baseline (reference
     # semantics, model.py:137-151); "flash" = Pallas fused kernel (VMEM
-    # score stripes, in-kernel dropout); "auto" = flash on TPU when the
+    # score stripes, in-kernel dropout); "ring" = sequence-parallel ring
+    # attention over the mesh's 'sp' axis (ops/ring_attention.py); "auto" =
+    # ring when the active mesh has sp>1, else flash on TPU when the
     # sequence length allows it, dense otherwise.
     attention_impl: str = "auto"
     # Training-loss path: "blocked" = logit-free chunked CE (ops/losses.py),
@@ -63,10 +65,10 @@ class GPT2Config:
             raise ValueError(
                 f"n_embd={self.n_embd} must be divisible by n_head={self.n_head}"
             )
-        if self.attention_impl not in ("auto", "dense", "flash"):
+        if self.attention_impl not in ("auto", "dense", "flash", "ring"):
             raise ValueError(
                 f"attention_impl={self.attention_impl!r}: expected "
-                "'auto', 'dense' or 'flash'"
+                "'auto', 'dense', 'flash' or 'ring'"
             )
         if self.loss_impl not in ("blocked", "dense"):
             raise ValueError(

@@ -76,10 +76,28 @@ def causal_attention_bthd(
     return out.transpose(0, 2, 1, 3)
 
 
+def _ring_mesh():
+    """The active mesh when its 'sp' axis is >1 (ring attention applies)."""
+    from gpt_2_distributed_tpu.parallel.mesh import SP_AXIS, active_mesh
+
+    m = active_mesh()
+    if m is not None and SP_AXIS in m.axis_names and m.shape[SP_AXIS] > 1:
+        return m
+    return None
+
+
 def select_attention_impl(impl: str, seq_len: int):
     """Resolve an attention implementation name to a callable taking
     ``[B, T, H, D]`` q/k/v (the model's native layout — no head transpose on
-    the hot path). Called at trace time (static shapes)."""
+    the hot path). Called at trace time (static shapes).
+
+    ``ring`` shards the sequence over the active mesh's 'sp' axis
+    (``ops/ring_attention.py``); with no active mesh or sp=1 it falls through
+    to the auto policy (a 1-rank ring is just local attention). ``auto``
+    prefers ring when sp>1 — an sp mesh whose attention ignored the axis
+    would silently replicate the sequence on every rank."""
+    import functools
+
     from gpt_2_distributed_tpu.ops.flash_attention import (
         flash_attention_bthd,
         pick_block_q,
@@ -89,7 +107,14 @@ def select_attention_impl(impl: str, seq_len: int):
         return causal_attention_bthd
     if impl == "flash":
         return flash_attention_bthd
-    if impl == "auto":
+    if impl in ("ring", "auto"):
+        mesh = _ring_mesh()
+        if mesh is not None:
+            from gpt_2_distributed_tpu.ops.ring_attention import (
+                ring_attention_bthd,
+            )
+
+            return functools.partial(ring_attention_bthd, mesh=mesh)
         import jax
 
         flash_ok = (
@@ -97,4 +122,6 @@ def select_attention_impl(impl: str, seq_len: int):
             and jax.devices()[0].platform == "tpu"
         )
         return flash_attention_bthd if flash_ok else causal_attention_bthd
-    raise ValueError(f"unknown attention_impl {impl!r}; expected dense|flash|auto")
+    raise ValueError(
+        f"unknown attention_impl {impl!r}; expected dense|flash|ring|auto"
+    )

@@ -84,8 +84,12 @@ DEFAULT_BLOCK_Q = 512  # fastest on v5e at seq 1024 (256/512/1024 swept)
 # col) coordinates and reuse the same mask.
 # ---------------------------------------------------------------------------
 
-BATCH_AXIS_NAMES = ("data", "fsdp", "dp", "batch", "replica")
-HEAD_AXIS_NAMES = ("tp", "model", "tensor")
+from gpt_2_distributed_tpu.ops.spmd import (  # noqa: E402 — after module docs
+    BATCH_AXIS_NAMES,
+    HEAD_AXIS_NAMES,
+    dividing_axes,
+    dropout_hash_bits,
+)
 
 
 def _ambient_mesh():
@@ -113,17 +117,10 @@ def pick_block_q(t: int, preferred: int = DEFAULT_BLOCK_Q) -> int | None:
 
 
 def _dropout_bits(seed, b, h, row_off, col_off, shape):
-    """Counter-based uint32 random bits for one [rows, cols] tile.
-
-    A murmur3-finalizer hash of the absolute (batch, head, row, col) position
-    mixed with the seed — stateless and blocking-independent, so the backward
-    kernel regenerates the forward's exact mask by construction, and the same
-    bits come out on TPU and in CPU interpret mode.
-    """
-    # Everything must be uint32 BEFORE any arithmetic: a stray int32 operand
-    # promotes the whole expression and turns >> into an arithmetic shift on
-    # negative values, silently changing the stream (and making traced program
-    # ids disagree with Python ints).
+    """Counter-based uint32 random bits for one [rows, cols] tile: 2-D iotas
+    over the shared ``spmd.dropout_hash_bits`` stream — the backward kernel
+    regenerates the forward's exact mask by construction, and the same bits
+    come out on TPU and in CPU interpret mode."""
     b = jnp.asarray(b).astype(jnp.uint32)
     h = jnp.asarray(h).astype(jnp.uint32)
     row = jnp.asarray(row_off).astype(jnp.uint32) + jax.lax.broadcasted_iota(
@@ -132,18 +129,7 @@ def _dropout_bits(seed, b, h, row_off, col_off, shape):
     col = jnp.asarray(col_off).astype(jnp.uint32) + jax.lax.broadcasted_iota(
         jnp.uint32, shape, 1
     )
-    x = (
-        seed.astype(jnp.uint32)
-        ^ (b * jnp.uint32(0x9E3779B1))
-        ^ (h * jnp.uint32(0x85EBCA77))
-    )
-    x = x ^ (row * jnp.uint32(0xC2B2AE3D)) ^ (col * jnp.uint32(0x27D4EB2F))
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x85EBCA6B)
-    x = x ^ (x >> 13)
-    x = x * jnp.uint32(0xC2B2AE35)
-    x = x ^ (x >> 16)
-    return x
+    return dropout_hash_bits(seed, b, h, row, col)
 
 
 def _fwd_kernel(
@@ -452,20 +438,8 @@ def flash_attention(
         # whatever batch-like / head-like axes divide the shapes (see module
         # SPMD comment). Axes of size 1 are skipped; a non-dividing axis set
         # falls through to the unwrapped call (single-device semantics).
-        def dividing_axes(names, dim):
-            # Greedy prefix of axes whose product divides `dim`; axes that
-            # don't divide are dropped (that slice of the mesh executes the
-            # kernel replicated rather than hitting Mosaic's unpartitionable
-            # custom-call error with a sharded operand).
-            axes, prod = [], 1
-            for a in mesh.axis_names:
-                if a in names and mesh.shape[a] > 1 and dim % (prod * mesh.shape[a]) == 0:
-                    axes.append(a)
-                    prod *= mesh.shape[a]
-            return tuple(axes)
-
-        b_axes = dividing_axes(BATCH_AXIS_NAMES, q.shape[0])
-        h_axes = dividing_axes(HEAD_AXIS_NAMES, q.shape[1])
+        b_axes = dividing_axes(mesh, BATCH_AXIS_NAMES, q.shape[0])
+        h_axes = dividing_axes(mesh, HEAD_AXIS_NAMES, q.shape[1])
         if b_axes or h_axes:
             spec = P(b_axes or None, h_axes or None, None, None)
 

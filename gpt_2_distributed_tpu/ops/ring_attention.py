@@ -1,0 +1,208 @@
+"""Ring attention: causal attention with the sequence sharded over 'sp'.
+
+Sequence/context parallelism is absent from the reference in every form
+(SURVEY.md §5.7: max context 1024, dense O(T^2) scores, no ring/blockwise/
+Ulysses) — this module is the beyond-parity capability that makes long
+contexts a mesh shape instead of a memory wall. Design (the standard ring
+schedule, cf. PAPERS.md ring-attention entry):
+
+* Each of the ``sp`` devices along the ring holds one contiguous sequence
+  block of Q, K and V: ``[B, T/sp, H, D]`` each. Q never moves.
+* ``sp`` ring steps: at step r the device combines its Q block with the K/V
+  block it currently holds (originally from rank ``(idx - r) % sp``) via the
+  online-softmax flash recurrence (running max ``m``, normalizer ``l``,
+  unnormalized accumulator ``acc``), then passes K/V to the next rank with
+  ``lax.ppermute`` — a neighbor exchange that rides ICI, never DCN-wide
+  collectives. XLA overlaps the permute with the block's matmuls.
+* Causality works on GLOBAL coordinates: query row ``idx*Tl + i`` attends to
+  key col ``src*Tl + j`` iff col <= row. One formula covers all three block
+  cases (src < idx: full, src == idx: triangular, src > idx: skip — fully
+  masked blocks contribute nothing and cost one gated matmul).
+
+Per-device memory is O(T/sp · T/sp) for one score block — long sequences
+scale by adding ring ranks. Per-block math runs on the MXU via XLA einsums
+(bf16 operands, fp32 accumulation), matching the dense/flash numerics; the
+Pallas flash kernel is not reused inside the ring because the recurrence
+needs raw (m, l, acc) carries across ring steps, which the fused kernel
+does not expose — fusing the two is a further optimization, not a
+correctness need.
+
+Differentiation is plain autodiff: the whole ring (scan + ppermute) is
+reverse-differentiable, with dropout applied through the same
+counter-based-hash bits the flash kernel uses (global coordinates, so the
+mask is independent of the ring schedule and the sp degree).
+
+Numerics vs. the dense parity path: identical to the flash kernel's contract
+(``ops/flash_attention.py`` module docstring) — masked lanes excluded via
+-1e30 before the row max instead of the reference's -1e4 additive mask; the
+difference is below bf16 resolution after softmax.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from gpt_2_distributed_tpu.ops.spmd import (
+    BATCH_AXIS_NAMES,
+    HEAD_AXIS_NAMES,
+    dividing_axes,
+    dropout_hash_bits,
+)
+
+NEG_INF = -1e30  # same fill as the flash kernel (fp32 row-max stability)
+
+
+def _dropout_bits_4d(seed, b_off, h_off, row_off, col_off, shape):
+    """Counter-based uint32 bits for a [b, h, rows, cols] block: 4-D iotas
+    over the shared ``spmd.dropout_hash_bits`` stream, offset by the shard's
+    GLOBAL (batch, head, row, col) origin — every position hashes its
+    absolute coordinates, so the mask is invariant to sp/batch/head sharding.
+    """
+    u = functools.partial(jnp.asarray, dtype=jnp.uint32)
+
+    def iota(axis):
+        return jax.lax.broadcasted_iota(jnp.uint32, shape, axis)
+
+    b = u(b_off) + iota(0)
+    h = u(h_off) + iota(1)
+    row = u(row_off) + iota(2)
+    col = u(col_off) + iota(3)
+    return dropout_hash_bits(seed, b, h, row, col)
+
+
+def _ring_local(
+    q,  # [b, tl, h, d] local Q block (model-native layout)
+    k,  # [b, tl, h, d]
+    v,  # [b, tl, h, d]
+    seed,  # [1] int32
+    *,
+    axis: str,
+    sp: int,
+    b_shard_axes: tuple[str, ...],
+    h_shard_axes: tuple[str, ...],
+    dropout_rate: float,
+):
+    """Device-local ring schedule; runs inside shard_map with axis ``axis``."""
+    b, tl, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    idx = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    # Global origins of this shard's batch/head dims, for the dropout hash.
+    def shard_offset(axes, local_dim):
+        off = jnp.uint32(0)
+        for a in axes:
+            off = off * jnp.uint32(jax.lax.axis_size(a)) + jax.lax.axis_index(
+                a).astype(jnp.uint32)
+        return off * jnp.uint32(local_dim)
+
+    b_off = shard_offset(b_shard_axes, b)
+    h_off = shard_offset(h_shard_axes, h)
+    kp = 1.0 - dropout_rate
+
+    row_g = idx * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+
+    def combine(k_c, v_c, m, l, acc, src):
+        """One online-softmax block update of (m, l, acc) against the K/V
+        block originally owned by rank ``src``."""
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_c, preferred_element_type=jnp.float32
+        ) * scale
+        col_g = src * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
+        mask = col_g <= row_g                       # [tl, tl], global causal
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        # Masked lanes forced to 0 (not exp(NEG_INF - m)): rows with no
+        # unmasked lane yet have m_new == NEG_INF and exp(0) would leak 1s.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)  # [b, h, tl, tl] f32
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            bits = _dropout_bits_4d(
+                seed[0], b_off, h_off, idx * tl, src * tl, p.shape
+            )
+            threshold = jnp.uint32(int(dropout_rate * (2**32)))
+            # Torch semantics via the flash kernel's identity: drop + rescale
+            # the unnormalized exponentials, divide by the UNdropped row sum.
+            p = jnp.where(bits >= threshold, p / kp, 0.0)
+        alpha_bthd = alpha.transpose(0, 2, 1, 3)     # [b, tl, h, 1]
+        acc = acc * alpha_bthd + jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v_c.dtype), v_c,
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    def body(carry, r):
+        # Rotate at the TOP: step r receives the block from r hops back, and
+        # the final iteration's blocks are actually consumed — sp-1 permutes
+        # total, not sp (the sp-th would just return K/V to their origins).
+        k_c, v_c, m, l, acc = carry
+        k_c = jax.lax.ppermute(k_c, axis, perm)
+        v_c = jax.lax.ppermute(v_c, axis, perm)
+        m, l, acc = combine(k_c, v_c, m, l, acc, (idx - r) % sp)
+        return (k_c, v_c, m, l, acc), None
+
+    m0 = jnp.full((b, h, tl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tl, 1), jnp.float32)
+    acc0 = jnp.zeros((b, tl, h, d), jnp.float32)
+    m1, l1, acc1 = combine(k, v, m0, l0, acc0, idx)   # own (diagonal) block
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        body, (k, v, m1, l1, acc1), jnp.arange(1, sp)
+    )
+    # Every row's diagonal element is always unmasked, so l > 0 everywhere.
+    return (acc / l.transpose(0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention_bthd(
+    q: jnp.ndarray,  # [B, T, H, D] (model-native layout)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    mesh,
+    axis: str = "sp",
+    dropout_rate: float = 0.0,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+) -> jnp.ndarray:
+    """Causal ring attention over mesh axis ``axis``; drop-in for
+    ``causal_attention_bthd`` when the sequence dim is sharded.
+
+    ``T`` must divide by the axis size. Batch/head dims are additionally
+    split over whatever data-like/tensor-like mesh axes divide them (same
+    policy as the flash kernel's shard_map wrapper).
+    """
+    B, T, H, D = q.shape
+    sp = mesh.shape[axis]
+    if T % sp != 0:
+        raise ValueError(
+            f"ring attention needs seq_len divisible by the '{axis}' axis: "
+            f"T={T}, {axis}={sp}"
+        )
+    rate = float(dropout_rate) if (not deterministic and rng is not None) else 0.0
+    if rate > 0.0:
+        seed = jax.random.randint(rng, (1,), 0, jnp.iinfo(jnp.int32).max, jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+
+    b_axes = dividing_axes(mesh, BATCH_AXIS_NAMES, B)
+    h_axes = dividing_axes(mesh, HEAD_AXIS_NAMES, H)
+    spec = P(b_axes or None, axis, h_axes or None, None)
+
+    local = functools.partial(
+        _ring_local,
+        axis=axis,
+        sp=sp,
+        b_shard_axes=b_axes,
+        h_shard_axes=h_axes,
+        dropout_rate=rate,
+    )
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec, P(None)),
+        out_specs=spec, check_vma=False,
+    )(q, k, v, seed)

@@ -1,0 +1,56 @@
+"""Shared SPMD helpers for attention kernels running under ``shard_map``.
+
+The flash kernel and ring attention both split work over whatever mesh axes
+divide their operand dims: batch over data-like axes, heads over tensor-like
+axes (ring additionally owns the sequence dim via the 'sp' axis). The axis
+vocabularies and the greedy divisibility scan live here so the two kernels
+cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+# Mesh axis names treated as batch-like (data parallel) / head-like (tensor
+# parallel) by the attention kernels. Our mesh uses ('data', 'fsdp', 'sp',
+# 'tp'); the extra names keep the kernels usable under user-supplied meshes.
+BATCH_AXIS_NAMES = ("data", "fsdp", "dp", "batch", "replica")
+HEAD_AXIS_NAMES = ("tp", "model", "tensor")
+
+
+def dividing_axes(mesh: Mesh, names: tuple[str, ...], dim: int) -> tuple[str, ...]:
+    """Greedy prefix of mesh axes from ``names`` whose product divides ``dim``.
+
+    Axes that don't divide are dropped — that slice of the mesh executes the
+    kernel replicated rather than hitting Mosaic's unpartitionable-custom-call
+    error with a sharded operand."""
+    axes: list[str] = []
+    prod = 1
+    for a in mesh.axis_names:
+        if a in names and mesh.shape[a] > 1 and dim % (prod * mesh.shape[a]) == 0:
+            axes.append(a)
+            prod *= mesh.shape[a]
+    return tuple(axes)
+
+
+def dropout_hash_bits(seed, b, h, row, col):
+    """uint32 random bits from a murmur3-finalizer hash of absolute
+    (batch, head, row, col) coordinates mixed with ``seed``.
+
+    The ONE dropout stream both attention kernels share: stateless and
+    blocking-independent, so the flash kernel's backward regenerates the
+    forward's exact mask by construction, and the ring schedule produces the
+    same mask regardless of the sp degree. All operands must be uint32
+    BEFORE any arithmetic — a stray int32 promotes the expression and turns
+    ``>>`` into an arithmetic shift on negative values, silently changing
+    the stream."""
+    u = jnp.uint32
+    x = seed.astype(jnp.uint32) ^ (b * u(0x9E3779B1)) ^ (h * u(0x85EBCA77))
+    x = x ^ (row * u(0xC2B2AE3D)) ^ (col * u(0x27D4EB2F))
+    x = x ^ (x >> 16)
+    x = x * u(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * u(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x

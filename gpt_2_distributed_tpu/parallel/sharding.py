@@ -94,11 +94,13 @@ def batch_pspec(leading_accum_axis: bool = True) -> P:
     """Batch sharding: the batch dim is split over BOTH mesh axes — under pure
     FSDP the mesh is (1, N) so this reproduces torch FULL_SHARD's
     data-parallelism across all ranks; under pure DP it is plain batch
-    sharding. The grad-accum axis (scanned) and sequence axis stay unsharded.
+    sharding. The sequence axis is sharded over 'sp' (sequence/ring
+    parallelism; a no-op at sp=1 — every rank holds the full sequence). The
+    grad-accum axis (scanned) stays unsharded.
     """
     if leading_accum_axis:
-        return P(None, (DATA_AXIS, FSDP_AXIS), None)
-    return P((DATA_AXIS, FSDP_AXIS), None)
+        return P(None, (DATA_AXIS, FSDP_AXIS), SP_AXIS)
+    return P((DATA_AXIS, FSDP_AXIS), SP_AXIS)
 
 
 def opt_state_pspecs(

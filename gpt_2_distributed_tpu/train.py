@@ -55,7 +55,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--mesh", default=None,
-        help="explicit mesh shape 'data=K,fsdp=N' (overrides --training_mode)",
+        help="explicit mesh shape 'data=K,fsdp=N[,sp=S][,tp=T]' (overrides "
+        "--training_mode); sp>1 shards the sequence (ring attention), tp>1 "
+        "shards weights Megatron-style",
+    )
+    p.add_argument(
+        "--attention_impl", default=None,
+        choices=["auto", "dense", "flash", "ring"],
+        help="attention kernel (default: the preset's 'auto' policy — ring "
+        "when the mesh has sp>1, flash on TPU, dense otherwise)",
     )
     p.add_argument("--model", default="124M", choices=sorted(MODEL_PRESETS))
     # Architecture overrides on top of the preset (smoke tests / ablations);
@@ -220,6 +228,8 @@ def main(argv: list[str] | None = None) -> None:
         n_positions=args.seq_len, remat=args.remat, scan_layers=scan_layers,
         loss_impl=args.loss_impl, **overrides
     )
+    if args.attention_impl:
+        config = config.replace(attention_impl=args.attention_impl)
 
     # --- mesh ---------------------------------------------------------------
     spec = MeshSpec.parse(args.mesh) if args.mesh else MeshSpec.for_mode(args.training_mode)
@@ -248,8 +258,12 @@ def main(argv: list[str] | None = None) -> None:
         from gpt_2_distributed_tpu.utils.device_info import print_device_info
 
         print_device_info()
+        extra = ""
+        if spec.sp > 1 or spec.tp > 1:
+            extra = f", sp={spec.sp}, tp={spec.tp}"
         print(
-            f"mesh: data={spec.data}, fsdp={spec.fsdp} | model: {args.model} "
+            f"mesh: data={spec.data}, fsdp={spec.fsdp}{extra} | "
+            f"model: {args.model} "
             f"({config.num_params()/1e6:.1f}M params) | "
             f"steps/epoch: {steps_per_epoch}"
         )

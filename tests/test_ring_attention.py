@@ -1,0 +1,146 @@
+"""Ring attention (sequence parallelism over the 'sp' mesh axis).
+
+The reference has no sequence parallelism in any form (SURVEY.md §5.7); ring
+attention is the beyond-parity capability that round-2 VERDICT item #5 asked
+to either implement or delete. These tests run the real ring schedule
+(shard_map + ppermute) on the suite's 8 virtual CPU devices and pin it to the
+dense parity implementation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpt_2_distributed_tpu.ops.attention import (
+    causal_attention_bthd,
+    select_attention_impl,
+)
+from gpt_2_distributed_tpu.ops.ring_attention import ring_attention_bthd
+from gpt_2_distributed_tpu.parallel.mesh import (
+    MeshSpec,
+    activate_mesh,
+    create_mesh,
+)
+
+
+def make_qkv(rng_np, B=4, T=256, H=2, D=32):
+    return tuple(
+        jnp.asarray(rng_np.normal(size=(B, T, H, D)), jnp.float32)
+        for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("spec", [
+    MeshSpec(data=2, fsdp=1, sp=2),
+    MeshSpec(data=2, fsdp=1, sp=4),
+    MeshSpec(data=1, fsdp=1, sp=8),
+])
+def test_ring_matches_dense(rng_np, spec):
+    q, k, v = make_qkv(rng_np)
+    dense = causal_attention_bthd(q, k, v)
+    mesh = create_mesh(spec)
+    with activate_mesh(mesh):
+        ring = jax.jit(
+            lambda a, b, c: ring_attention_bthd(a, b, c, mesh=mesh)
+        )(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), atol=2e-5)
+
+
+def test_ring_grads_match_dense(rng_np):
+    q, k, v = make_qkv(rng_np)
+    mesh = create_mesh(MeshSpec(data=2, fsdp=1, sp=4))
+
+    def loss_ring(q, k, v):
+        with activate_mesh(mesh):
+            return jnp.sum(ring_attention_bthd(q, k, v, mesh=mesh) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention_bthd(q, k, v) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("spec", [
+    MeshSpec(data=2, fsdp=1, sp=4),
+    MeshSpec(data=1, fsdp=2, sp=2),
+])
+def test_ring_train_step_matches_local(tiny_config, rng_np, spec):
+    """A full sharded train step with the sequence dim split over 'sp'
+    (batch_pspec shards seq; config 'auto' resolves to ring) reproduces the
+    single-device loss sequence exactly at fp32."""
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.sharding import (
+        shard_batch,
+        shard_params_and_opt_state,
+    )
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    x = rng_np.integers(0, 257, (2, 8, 64), dtype=np.int32)
+    y = rng_np.integers(0, 257, (2, 8, 64), dtype=np.int32)
+    key = jax.random.PRNGKey(0)
+
+    def run(mesh_spec):
+        params = gpt2.init_params(tiny_config)
+        opt = make_optimizer(1e-3)
+        mesh = create_mesh(mesh_spec)
+        losses = []
+        with activate_mesh(mesh):
+            params, opt_state, _, _ = shard_params_and_opt_state(
+                params, opt, mesh
+            )
+            step = make_train_step(
+                tiny_config, opt, compute_dtype=jnp.float32, donate=False
+            )
+            xb, yb = shard_batch((x, y), mesh)
+            for i in range(3):
+                params, opt_state, m = step(params, opt_state, xb, yb, key, i)
+                losses.append(float(m.loss))
+        return losses
+
+    base = run(MeshSpec(1, 1))
+    got = run(spec)
+    assert base[-1] < base[0], "loss did not descend"
+    np.testing.assert_allclose(got, base, rtol=0, atol=5e-5)
+
+
+def test_ring_dropout_deterministic_and_active(rng_np):
+    """Dropout inside the ring: same rng -> identical output; different rng
+    -> different masks; and the dropped output deviates from the
+    deterministic one (the mask is actually applied)."""
+    q, k, v = make_qkv(rng_np, B=2, T=128)
+    mesh = create_mesh(MeshSpec(data=2, fsdp=1, sp=4))
+    kw = dict(mesh=mesh, dropout_rate=0.3, deterministic=False)
+    with activate_mesh(mesh):
+        o1 = ring_attention_bthd(q, k, v, rng=jax.random.PRNGKey(1), **kw)
+        o2 = ring_attention_bthd(q, k, v, rng=jax.random.PRNGKey(1), **kw)
+        o3 = ring_attention_bthd(q, k, v, rng=jax.random.PRNGKey(2), **kw)
+        base = ring_attention_bthd(q, k, v, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert float(jnp.max(jnp.abs(o1 - o3))) > 1e-3
+    assert float(jnp.max(jnp.abs(o1 - base))) > 1e-3
+
+
+def test_ring_rejects_indivisible_seq(rng_np):
+    q, k, v = make_qkv(rng_np, T=100)  # 100 % 8 != 0
+    mesh = create_mesh(MeshSpec(data=1, fsdp=1, sp=8))
+    with pytest.raises(ValueError, match="divisible"):
+        ring_attention_bthd(q, k, v, mesh=mesh)
+
+
+def test_auto_selects_ring_under_sp_mesh():
+    mesh = create_mesh(MeshSpec(data=2, fsdp=1, sp=4))
+    with activate_mesh(mesh):
+        fn = select_attention_impl("auto", 256)
+        assert getattr(fn, "func", None) is ring_attention_bthd
+        fn = select_attention_impl("ring", 256)
+        assert getattr(fn, "func", None) is ring_attention_bthd
+    # Outside the sp mesh, 'ring' degrades to the auto policy (local attn).
+    fn = select_attention_impl("ring", 256)
+    assert fn is not ring_attention_bthd

@@ -116,6 +116,29 @@ def test_cli_eval_every(capsys, shard_dir, tmp_path):
     assert all(e > 0 for e in evals)
 
 
+def test_cli_sp_mesh_ring_attention(capsys, shard_dir):
+    """--mesh with sp>1: the sequence dim is sharded and 'auto' resolves to
+    ring attention; training still descends."""
+    out = run_cli(
+        capsys,
+        "--data_dir", shard_dir,
+        "--n_layer", "2",
+        "--n_embd", "32",
+        "--n_head", "2",
+        "--vocab_size", "257",
+        "--mesh", "data=2,fsdp=2,sp=2",
+        "--seq_len", "32",
+        "--batch", "8",
+        "--grad_accum_steps", "1",
+        "--max_steps", "4",
+        "--lr", "3e-3",
+        "--cli_every", "1",
+    )
+    assert "sp=2" in out
+    losses = losses_from(out)
+    assert losses and losses[-1] < losses[0], out
+
+
 def test_cli_device_flag(shard_dir):
     """--device pins the JAX platform (reference CLI parity,
     /root/reference/train_gpt2_distributed.py:292-294).
