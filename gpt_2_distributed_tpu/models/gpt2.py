@@ -61,6 +61,7 @@ def init_params(
     leading ``n_layer`` axis.
     """
     c, l, v, p = config.n_embd, config.n_layer, config.vocab_size, config.n_positions
+    h = config.n_head
     std = config.initializer_range
     key = jax.random.PRNGKey(seed)
     k_wte, k_wpe, k_attn, k_attn_proj, k_fc1, k_fc2 = jax.random.split(key, 6)
@@ -77,8 +78,15 @@ def init_params(
         "block": {
             "ln1_scale": ones((l, c)),
             "ln1_bias": zeros((l, c)),
-            "attn_qkv_w": normal(k_attn, (l, c, 3 * c)),
-            "attn_qkv_b": zeros((l, 3 * c)),
+            # Fused qkv stored head-explicit [L, C, 3, H, D] rather than the
+            # reference's [C, 3C] q|k|v concatenation (model.py:95): the same
+            # matmul (the flat layouts are bit-identical under reshape — 3C
+            # factors as (3, H, D) row-major), but the head dim is a real
+            # tensor axis, so tensor parallelism can column-shard it — with
+            # [C, 3C], tp slices of the fused dim would mix q/k/v columns,
+            # which is why round 2 left qkv replicated (25% of block flops).
+            "attn_qkv_w": normal(k_attn, (l, c, 3, h, c // h)),
+            "attn_qkv_b": zeros((l, 3, h, c // h)),
             "attn_proj_w": normal(k_attn_proj, (l, c, c)),
             "attn_proj_b": zeros((l, c)),
             "ln2_scale": ones((l, c)),
@@ -106,7 +114,6 @@ def _attn_sublayer(
 ) -> jnp.ndarray:
     """x + dropout(proj(attn(ln1(x))))."""
     b, t, c = x.shape
-    h, d = config.n_head, config.head_dim
     cdt = x.dtype
     if rng is not None:
         r_attn, r_aresid = jax.random.split(rng)
@@ -116,12 +123,17 @@ def _attn_sublayer(
     # q/k/v stay in [B, T, H, D] — the flash kernel transposes at its own
     # boundary where XLA can fold the permute into the reshape (the
     # reference's permute at model.py:124-129 is a layout copy on GPU).
+    # One einsum over the head-explicit [C, 3, H, D] weight (see init_params);
+    # under tp>1 the H axis is column-sharded and GSPMD keeps q/k/v sharded
+    # by head from here through the attention kernel to the row-sharded
+    # out-projection.
     y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
-    qkv = y @ bp["attn_qkv_w"].astype(cdt) + bp["attn_qkv_b"].astype(cdt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, t, h, d)
-    k = k.reshape(b, t, h, d)
-    v = v.reshape(b, t, h, d)
+    qkv = jnp.einsum(
+        "btc,cshd->btshd", y, bp["attn_qkv_w"].astype(cdt)
+    ) + bp["attn_qkv_b"].astype(cdt)
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
     attn_fn = select_attention_impl(config.attention_impl, t)
     o = attn_fn(
         q, k, v,

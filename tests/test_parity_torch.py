@@ -50,8 +50,11 @@ def _to_hf(params, config):
         prefix = f"transformer.h.{i}."
         sd[prefix + "ln_1.weight"] = t(b["ln1_scale"][i])
         sd[prefix + "ln_1.bias"] = t(b["ln1_bias"][i])
-        sd[prefix + "attn.c_attn.weight"] = t(b["attn_qkv_w"][i])
-        sd[prefix + "attn.c_attn.bias"] = t(b["attn_qkv_b"][i])
+        # Our head-explicit [C, 3, H, D] flattens row-major to HF Conv1D's
+        # [C, 3C] q|k|v column order (3 outer, head, head_dim inner).
+        c = config.n_embd
+        sd[prefix + "attn.c_attn.weight"] = t(b["attn_qkv_w"][i]).reshape(c, 3 * c)
+        sd[prefix + "attn.c_attn.bias"] = t(b["attn_qkv_b"][i]).reshape(3 * c)
         sd[prefix + "attn.c_proj.weight"] = t(b["attn_proj_w"][i])
         sd[prefix + "attn.c_proj.bias"] = t(b["attn_proj_b"][i])
         sd[prefix + "ln_2.weight"] = t(b["ln2_scale"][i])
