@@ -91,9 +91,34 @@ def param_pspecs(params: Any, mesh: Mesh) -> Any:
     tree such as optimizer moments)."""
     fsdp_size = mesh.shape[FSDP_AXIS] if FSDP_AXIS in mesh.axis_names else 1
     tp_size = mesh.shape[TP_AXIS] if TP_AXIS in mesh.axis_names else 1
-    return jax.tree_util.tree_map_with_path(
+    specs = jax.tree_util.tree_map_with_path(
         lambda path, leaf: _leaf_pspec(path, leaf, fsdp_size, tp_size), params
     )
+    if tp_size > 1:
+        # A tp degree that doesn't divide a leaf's shardable dim silently
+        # no-ops for that leaf (it stays replicated); that is correct but
+        # costs the replicated flops tp exists to remove — say so once.
+        # E.g. the 1.5B preset's n_head=25 rejects tp=2 on qkv (tp=5 works).
+        import warnings
+
+        flat = jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]
+        undivided = [
+            "/".join(str(getattr(k, "key", k)) for k in path)
+            for path, spec in flat
+            if any(n in (_TP_ROW_LEAVES | _TP_COL_LEAVES | set(_TP_HEAD_LEAVES))
+                   for n in [str(getattr(path[-1], "key", path[-1]))])
+            and TP_AXIS not in tuple(spec)
+        ]
+        if undivided:
+            warnings.warn(
+                f"tp={tp_size} does not divide the shardable dim of "
+                f"{undivided}; these weights stay REPLICATED across 'tp' "
+                f"(wasted flops). Pick a tp that divides n_head and the "
+                f"projection dims.",
+                stacklevel=2,
+            )
+    return specs
 
 
 def batch_pspec(leading_accum_axis: bool = True) -> P:

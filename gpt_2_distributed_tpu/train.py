@@ -336,16 +336,17 @@ def main(argv: list[str] | None = None) -> None:
                     )
                 val_paths = []
             if val_paths:
-                # Deliberately UNsharded (process 0-of-1 identity): the
+                # Window-strided across processes (shard_windows=True): the
                 # pipeline's convention is a single val shard (shard 0), so
-                # process-striding would give every host but one zero batches
-                # and n_eval would collapse to 0. Each process streams the
-                # same windows instead; its shard_batch slice duplicates data
-                # across hosts, which leaves the mean eval loss unchanged.
+                # shard-striding would give every host but one zero batches —
+                # instead each host reads a disjoint 1/processes slice of the
+                # windows and the hosts' slices assemble into one GLOBAL
+                # batch (shard_batch's make_array_from_process_local_data
+                # path), so eval cost is O(1/hosts) per host and the
+                # eval_step's loss is already the global mean.
                 eval_dataset = TokenShardDataset(
                     val_paths, seq_len=args.seq_len, num_workers=1,
-                    process_index=0, process_count=1,
-                    vocab_size=config.vocab_size,
+                    vocab_size=config.vocab_size, shard_windows=True,
                 )
                 eval_dataset.set_epoch(0)
                 eval_step = make_eval_step(config)
@@ -361,13 +362,17 @@ def main(argv: list[str] | None = None) -> None:
                             "eval disabled"
                         )
                 else:
+                    # One loader for the whole run; each eval re-iterates it
+                    # (deterministic: the epoch-0 permutation every time, so
+                    # successive evals score the same global batches).
+                    eval_loader = create_dataloader(
+                        eval_dataset, batch_size=local_batch,
+                        prefetch_factor=args.prefetch_factor,
+                    )
+
                     def run_eval(cur_params) -> float:
                         losses = []
-                        loader = create_dataloader(
-                            eval_dataset, batch_size=local_batch,
-                            prefetch_factor=args.prefetch_factor,
-                        )
-                        for i, (xb, yb) in enumerate(loader):
+                        for i, (xb, yb) in enumerate(eval_loader):
                             if i >= n_eval:
                                 break
                             xs, ys = shard_batch((xb, yb), mesh,

@@ -57,7 +57,20 @@ from gpt_2_distributed_tpu.parallel.train_step import (  # noqa: E402
 )
 
 
+def _checksum(tree) -> str:
+    """Order-stable md5 over every leaf's raw bytes — equal digests across
+    phases prove the restore is bit-exact (an abs-sum would be blind to sign
+    flips or any abs-preserving corruption)."""
+    import hashlib
+
+    h = hashlib.md5()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        h.update(np.ascontiguousarray(np.asarray(jax.device_get(leaf))).tobytes())
+    return h.hexdigest()
+
+
 def main() -> None:
+    phase = sys.argv[1] if len(sys.argv) > 1 else "train"
     # Exercises the env-var contract: MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK.
     init_distributed()
     assert jax.process_count() == 2, f"process_count={jax.process_count()}"
@@ -82,32 +95,87 @@ def main() -> None:
     lo, hi = (0, 4) if rank == 0 else (4, 8)
     x_local, y_local = x_global[:, lo:hi], y_global[:, lo:hi]
 
-    params = gpt2.init_params(config)
+    record = {"rank": rank, "is_primary": is_primary()}
     optimizer = make_optimizer(1e-3)
-    with activate_mesh(mesh):
-        params, opt_state, _, _ = shard_params_and_opt_state(
-            params, optimizer, mesh
-        )
-        # multi-host branch: make_array_from_process_local_data
-        xs, ys = shard_batch((x_local, y_local), mesh)
-        assert xs.shape == (1, 8, 32), f"global batch shape {xs.shape}"
-        step = make_train_step(config, optimizer)
-        key = jax.random.PRNGKey(0)
-        params, opt_state, metrics = step(params, opt_state, xs, ys, key, 0)
-        loss = float(metrics.loss)
-        grad_norm = float(metrics.grad_norm)
+    key = jax.random.PRNGKey(0)
 
-    # multi-host branch: process_allgather mean over per-rank values.
-    reduced = _default_reduce({"val": float(rank * 10 + 1), "const": 7.0})
+    if phase == "train":
+        params = gpt2.init_params(config)
+        with activate_mesh(mesh):
+            params, opt_state, _, _ = shard_params_and_opt_state(
+                params, optimizer, mesh
+            )
+            # multi-host branch: make_array_from_process_local_data
+            xs, ys = shard_batch((x_local, y_local), mesh)
+            assert xs.shape == (1, 8, 32), f"global batch shape {xs.shape}"
+            step = make_train_step(config, optimizer)
+            params, opt_state, metrics = step(params, opt_state, xs, ys, key, 0)
+            record["loss"] = float(metrics.loss)
+            record["grad_norm"] = float(metrics.grad_norm)
 
-    print(json.dumps({
-        "rank": rank,
-        "is_primary": is_primary(),
-        "loss": loss,
-        "grad_norm": grad_norm,
-        "reduced_val": reduced["val"],
-        "reduced_const": reduced["const"],
-    }))
+        # multi-host branch: process_allgather mean over per-rank values.
+        reduced = _default_reduce({"val": float(rank * 10 + 1), "const": 7.0})
+        record["reduced_val"] = reduced["val"]
+        record["reduced_const"] = reduced["const"]
+
+    elif phase == "save":
+        # Round-2 VERDICT next-step #3: a REAL multi-process sharded orbax
+        # save — the exact shape (all ranks inside the collective) whose
+        # rank-gated analogue deadlocks in the reference (SURVEY.md C13).
+        from gpt_2_distributed_tpu import checkpoint as ckpt
+
+        ckpt_dir = os.environ["CKPT_DIR"]
+        params = gpt2.init_params(config)
+        with activate_mesh(mesh):
+            params, opt_state, _, _ = shard_params_and_opt_state(
+                params, optimizer, mesh
+            )
+            xs, ys = shard_batch((x_local, y_local), mesh)
+            step = make_train_step(config, optimizer, donate=False)
+            params, opt_state, m0 = step(params, opt_state, xs, ys, key, 0)
+            ckpt.save_checkpoint(
+                ckpt_dir, 1, params, opt_state,
+                ckpt.CheckpointMeta(
+                    step=1, epoch=0, batches_in_epoch=1, rng_seed=0
+                ),
+            )
+            record["params_sum"] = _checksum(params)
+            record["opt_sum"] = _checksum(opt_state)
+            params, opt_state, m1 = step(params, opt_state, xs, ys, key, 1)
+            record["loss0"] = float(m0.loss)
+            record["loss1"] = float(m1.loss)
+
+    elif phase == "restore":
+        # Fresh process pair (real restart): restore the sharded checkpoint
+        # onto the mesh and continue — the continuation loss must equal the
+        # uninterrupted run's bit-for-bit.
+        from gpt_2_distributed_tpu import checkpoint as ckpt
+
+        ckpt_dir = os.environ["CKPT_DIR"]
+        # Deliberately DIFFERENT init (seed 7): restore must overwrite every
+        # leaf; any leaf it missed would poison the continuation loss.
+        params = gpt2.init_params(config, seed=7)
+        with activate_mesh(mesh):
+            params, opt_state, pshard, oshard = shard_params_and_opt_state(
+                params, optimizer, mesh
+            )
+            latest = ckpt.latest_checkpoint(ckpt_dir)
+            assert latest is not None, f"no checkpoint in {ckpt_dir}"
+            params, opt_state, meta = ckpt.restore_checkpoint(
+                latest, params, opt_state, pshard, oshard
+            )
+            record["meta_step"] = meta.step
+            record["params_sum"] = _checksum(params)
+            record["opt_sum"] = _checksum(opt_state)
+            xs, ys = shard_batch((x_local, y_local), mesh)
+            step = make_train_step(config, optimizer, donate=False)
+            params, opt_state, m1 = step(params, opt_state, xs, ys, key, 1)
+            record["loss1"] = float(m1.loss)
+
+    else:
+        raise SystemExit(f"unknown phase {phase!r}")
+
+    print(json.dumps(record))
     sys.stdout.flush()
     jax.distributed.shutdown()
 

@@ -123,3 +123,49 @@ def test_export_full_params(tiny_config):
     assert flat["wte"].shape == (tiny_config.vocab_size, tiny_config.n_embd)
     total = sum(v.size for v in flat.values())
     assert total == gpt2.count_params(params)
+
+
+def test_restore_migrates_legacy_qkv_layout(tmp_path, tiny_config):
+    """A checkpoint saved with the pre-head-explicit fused-qkv layout
+    ([L, C, 3C] / [L, 3C]) restores into the current [L, C, 3, H, D] model:
+    same bytes, different factoring — the migration reshapes losslessly."""
+    import jax.numpy as jnp
+
+    params = gpt2.init_params(tiny_config)
+    optimizer = make_optimizer(1e-3)
+    opt_state = optimizer.init(params)
+
+    def flatten_qkv(tree):
+        out = jax.tree_util.tree_map(lambda x: x, tree)  # copy structure
+        blk = dict(out["block"])
+        l, c = tiny_config.n_layer, tiny_config.n_embd
+        blk["attn_qkv_w"] = jnp.reshape(blk["attn_qkv_w"], (l, c, 3 * c))
+        blk["attn_qkv_b"] = jnp.reshape(blk["attn_qkv_b"], (l, 3 * c))
+        out["block"] = blk
+        return out
+
+    legacy_params = flatten_qkv(params)
+    # opt_state's mu/nu mirror the param tree; flatten them the same way.
+    legacy_opt = jax.tree_util.tree_map(lambda x: x, opt_state)
+    legacy_opt = (
+        legacy_opt[0]._replace(
+            mu=flatten_qkv(legacy_opt[0].mu), nu=flatten_qkv(legacy_opt[0].nu)
+        ),
+    ) + tuple(legacy_opt[1:])
+
+    path = ckpt.save_checkpoint(
+        str(tmp_path), 3, legacy_params, legacy_opt,
+        ckpt.CheckpointMeta(step=3, epoch=0, batches_in_epoch=3, rng_seed=42),
+    )
+    restored_p, restored_o, meta = ckpt.restore_checkpoint(
+        path, params, opt_state
+    )
+    assert meta.step == 3
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, restored_p,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        opt_state, restored_o,
+    )

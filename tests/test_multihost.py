@@ -34,8 +34,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.fixture(scope="module")
-def worker_results():
+def _run_worker_pair(phase: str, extra_env: dict | None = None) -> list[dict]:
+    """Launch 2 real worker processes for one phase; return per-rank JSON."""
     port = _free_port()
     env_base = {
         **os.environ,
@@ -43,10 +43,11 @@ def worker_results():
         "MASTER_PORT": str(port),
         "WORLD_SIZE": "2",
         "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        **(extra_env or {}),
     }
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER],
+            [sys.executable, WORKER, phase],
             env={**env_base, "RANK": str(rank)},
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
@@ -61,10 +62,18 @@ def worker_results():
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            pytest.fail("multi-host worker timed out (rendezvous deadlock?)")
+            pytest.fail(
+                f"multi-host worker ({phase}) timed out (rendezvous or "
+                f"collective deadlock?)"
+            )
         assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
     return sorted(outs, key=lambda r: r["rank"])
+
+
+@pytest.fixture(scope="module")
+def worker_results():
+    return _run_worker_pair("train")
 
 
 def test_two_processes_rendezvous_and_agree(worker_results):
@@ -126,3 +135,32 @@ def test_tracker_reduce_is_cross_process_mean(worker_results):
     assert r1["reduced_val"] == pytest.approx(6.0)
     # a value equal on all ranks reduces to itself
     assert r0["reduced_const"] == pytest.approx(7.0)
+
+
+def test_multiprocess_checkpoint_save_restore(tmp_path_factory):
+    """Round-2 VERDICT next-step #3: sharded orbax save with ALL processes in
+    the collective, then a REAL restart (fresh process pair) that restores
+    onto the mesh and continues training.
+
+    Checks: (a) the save completes on both ranks without the rank-gated
+    deadlock the reference's C13 shape would hit; (b) restore is bit-exact
+    (param/opt-state checksums equal across phases despite the restore phase
+    initializing from a different seed); (c) the continuation step's loss
+    equals the uninterrupted run's bit-for-bit."""
+    ckpt_dir = str(tmp_path_factory.mktemp("mh_ckpt"))
+    saved = _run_worker_pair("save", {"CKPT_DIR": ckpt_dir})
+    restored = _run_worker_pair("restore", {"CKPT_DIR": ckpt_dir})
+
+    s0, s1 = saved
+    r0, r1 = restored
+    # Both save-phase ranks agree on the losses (global collectives).
+    assert s0["loss0"] == pytest.approx(s1["loss0"], rel=1e-6)
+    assert s0["loss1"] == pytest.approx(s1["loss1"], rel=1e-6)
+    # Restore saw the metadata.
+    assert r0["meta_step"] == 1 and r1["meta_step"] == 1
+    # Bit-exact state round-trip: abs-sum checksums equal exactly.
+    assert r0["params_sum"] == s0["params_sum"]
+    assert r0["opt_sum"] == s0["opt_sum"]
+    # The continuation reproduces the uninterrupted step-1 loss exactly.
+    assert r0["loss1"] == s0["loss1"]
+    assert r1["loss1"] == s1["loss1"]
