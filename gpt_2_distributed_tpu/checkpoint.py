@@ -126,45 +126,60 @@ def _restore_with_layout_migration(
     reshape). Exists for stored-layout evolutions — e.g. the fused qkv
     moving from [L, C, 3C] to head-explicit [L, C, 3, H, D] (bit-identical
     data, different factoring) — so pre-change checkpoints stay loadable."""
+    unplaced = False
     try:
         restored = ckptr.restore(item_path, _as_abstract(template, shardings))
     except (ValueError, TypeError) as exc:
         if "shape" not in str(exc).lower():
             raise
         # Sharded restore rejected the saved shapes outright: re-read the
-        # checkpoint in its own saved structure (host arrays) and let the
-        # normalization below reshape and place the leaves.
+        # checkpoint in its own saved structure (host arrays, NO mesh
+        # placement) and let the normalization below reshape and place
+        # every leaf.
         restored = ckptr.restore(item_path)
+        unplaced = True
 
     # Normalize: orbax may also silently hand back the SAVED shapes when the
     # abstract target disagrees, so shape conformance is enforced here either
-    # way. Size-matching mismatches reshape losslessly; anything else is a
-    # genuine incompatibility.
+    # way. A mismatch migrates only when it is a pure re-factoring of the
+    # same data: equal element count, equal dtype, and different rank — a
+    # same-rank reshape like [.., 4, 64] -> [.., 8, 32] (n_head changed) is
+    # semantically a different model and stays a hard error.
     flat_res, treedef_res = jax.tree_util.tree_flatten(restored)
     flat_tmpl, treedef_tmpl = jax.tree_util.tree_flatten(template)
-    flat_shard = (
-        jax.tree_util.tree_flatten(
-            shardings, is_leaf=lambda x: x is not None and not isinstance(x, (dict, list, tuple))
-        )[0]
-        if shardings is not None
-        else [None] * len(flat_tmpl)
-    )
     if treedef_res != treedef_tmpl or len(flat_res) != len(flat_tmpl):
         raise ValueError(
             f"checkpoint {item_path} has a different tree structure than the "
             f"current model; cannot migrate"
         )
+    if shardings is None:
+        flat_shard = [None] * len(flat_tmpl)
+    else:
+        flat_shard = jax.tree_util.tree_flatten(shardings)[0]
+        if len(flat_shard) != len(flat_tmpl):
+            raise ValueError(
+                f"shardings tree has {len(flat_shard)} leaves but the "
+                f"template has {len(flat_tmpl)}; cannot align"
+            )
     out = []
     for s, t, sh in zip(flat_res, flat_tmpl, flat_shard):
         if np.shape(s) != np.shape(t):
-            if np.size(s) != np.size(t):
+            same_data = (
+                np.size(s) == np.size(t)
+                and np.asarray(s).dtype == np.asarray(t).dtype
+                and np.ndim(s) != np.ndim(t)
+            )
+            if not same_data:
                 raise ValueError(
-                    f"checkpoint leaf shape {np.shape(s)} is incompatible "
-                    f"with model shape {np.shape(t)}"
+                    f"checkpoint leaf shape {np.shape(s)}/"
+                    f"{np.asarray(s).dtype} is incompatible with model "
+                    f"shape {np.shape(t)}/{np.asarray(t).dtype}"
                 )
             s = np.asarray(jax.device_get(s)).reshape(np.shape(t))
-            if sh is not None:
-                s = jax.device_put(s, sh)
+        if unplaced and sh is not None:
+            # The fallback read skipped mesh placement for EVERY leaf, not
+            # just reshaped ones — place them all.
+            s = jax.device_put(np.asarray(jax.device_get(s)), sh)
         out.append(s)
     return jax.tree_util.tree_unflatten(treedef_tmpl, out)
 

@@ -101,13 +101,13 @@ def param_pspecs(params: Any, mesh: Mesh) -> Any:
         # E.g. the 1.5B preset's n_head=25 rejects tp=2 on qkv (tp=5 works).
         import warnings
 
+        tp_leaves = _TP_ROW_LEAVES | _TP_COL_LEAVES | set(_TP_HEAD_LEAVES)
         flat = jax.tree_util.tree_flatten_with_path(
             specs, is_leaf=lambda x: isinstance(x, P))[0]
         undivided = [
             "/".join(str(getattr(k, "key", k)) for k in path)
             for path, spec in flat
-            if any(n in (_TP_ROW_LEAVES | _TP_COL_LEAVES | set(_TP_HEAD_LEAVES))
-                   for n in [str(getattr(path[-1], "key", path[-1]))])
+            if str(getattr(path[-1], "key", path[-1])) in tp_leaves
             and TP_AXIS not in tuple(spec)
         ]
         if undivided:

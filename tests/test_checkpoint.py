@@ -169,3 +169,27 @@ def test_restore_migrates_legacy_qkv_layout(tmp_path, tiny_config):
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         opt_state, restored_o,
     )
+
+
+def test_restore_rejects_same_rank_reshape(tmp_path, tiny_config):
+    """A same-rank size-preserving shape change (e.g. a different n_head
+    split) is a DIFFERENT model, not a layout migration — restore must raise
+    rather than silently reshape semantically-wrong weights."""
+    import jax.numpy as jnp
+
+    params = gpt2.init_params(tiny_config)
+    optimizer = make_optimizer(1e-3)
+    opt_state = optimizer.init(params)
+    l, c = tiny_config.n_layer, tiny_config.n_embd
+    h = tiny_config.n_head
+    bad = {**params, "block": dict(params["block"])}
+    # Same rank (5) and size, different head split: h*2 heads of d/2.
+    bad["block"]["attn_qkv_w"] = jnp.reshape(
+        bad["block"]["attn_qkv_w"], (l, c, 3, h * 2, (c // h) // 2)
+    )
+    path = ckpt.save_checkpoint(
+        str(tmp_path), 1, bad, opt_state,
+        ckpt.CheckpointMeta(step=1, epoch=0, batches_in_epoch=1, rng_seed=42),
+    )
+    with pytest.raises(ValueError, match="incompatible"):
+        ckpt.restore_checkpoint(path, params, opt_state)

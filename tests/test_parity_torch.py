@@ -202,3 +202,78 @@ def test_adamw_semantics_match_torch(parity_setup):
     jw = np.asarray(optax.apply_updates(jnp.asarray(w0), updates))
 
     np.testing.assert_allclose(jw, tw.detach().numpy(), atol=1e-6)
+
+
+def test_bf16_medium_horizon_curve_parity():
+    """200-step bf16 loss-curve parity at realistic width (round-2 VERDICT
+    next-step #2): 4 layers x 256 width, seq 256, OUR bf16 train step
+    (fp32 params, bf16 matmuls, fp32 LN/softmax/CE, blocked loss) vs torch
+    bf16 autocast + fp32 CE + AdamW on identical learnable data — the
+    compounding test for the bf16 boundaries + blocked CE combination that
+    the 8-step fp32 test cannot see.
+
+    Tolerance: calibrated against a recorded 200-step run (PARITY.md) where
+    the max per-step divergence was 1.5e-3 during the steepest descent and
+    <1e-5 at convergence; bands carry ~10x margin over that."""
+    import jax
+
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    config = GPT2Config(
+        vocab_size=257, n_positions=256, n_embd=256, n_layer=4, n_head=4,
+        embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
+    )
+    params = gpt2.init_params(config, seed=42)
+    tmodel = _to_hf(params, config)
+    tmodel.train()
+    lr = 3e-4
+    topt = torch.optim.AdamW(
+        tmodel.parameters(), lr=lr, betas=(0.9, 0.95), eps=1e-8,
+        weight_decay=0.1,
+    )
+
+    opt = make_optimizer(lr)
+    opt_state = opt.init(params)
+    step_fn = make_train_step(config, opt, donate=False)  # bf16 compute
+
+    # Learnable ascending runs (the synthetic-shard recipe): the curve must
+    # DESCEND from ln(257)~5.55 to ~1e-2, so parity is tested across the
+    # whole loss range, not on a flat random-token plateau.
+    STEPS, B, T = 200, 4, 256
+    rng = np.random.default_rng(1)
+    starts = rng.integers(0, config.vocab_size, (STEPS, B, 1))
+    seqs = (starts + np.arange(T + 1)) % config.vocab_size
+    xs = seqs[:, :, :-1].astype(np.int64)
+    ys = seqs[:, :, 1:].astype(np.int64)
+
+    key = jax.random.PRNGKey(0)  # dropout off; value irrelevant
+    ours, theirs = [], []
+    for i in range(STEPS):
+        x1 = jnp.asarray(xs[i], jnp.int32)[None]
+        y1 = jnp.asarray(ys[i], jnp.int32)[None]
+        params, opt_state, m = step_fn(params, opt_state, x1, y1, key, i)
+        ours.append(float(m.loss))
+
+        xb = torch.tensor(xs[i])
+        with torch.autocast("cpu", dtype=torch.bfloat16):
+            logits = tmodel(xb).logits
+        loss_t = torch.nn.functional.cross_entropy(
+            logits.reshape(-1, config.vocab_size).float(),
+            torch.tensor(ys[i]).reshape(-1),
+            ignore_index=-100,
+        )
+        topt.zero_grad(set_to_none=True)
+        loss_t.backward()
+        topt.step()
+        theirs.append(float(loss_t.detach()))
+
+    o, t = np.asarray(ours), np.asarray(theirs)
+    # Both curves converge (learnable task): well below the ln(257) plateau.
+    assert o[-1] < 0.05 and t[-1] < 0.05, (o[-1], t[-1])
+    # Track within 10x the recorded peak divergence at every step...
+    assert float(np.max(np.abs(o - t))) < 2e-2, np.max(np.abs(o - t))
+    # ...and essentially exactly once converged.
+    assert float(np.mean(np.abs(o[-50:] - t[-50:]))) < 1e-3
