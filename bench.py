@@ -34,10 +34,16 @@ def main() -> None:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument(
         "--remat", nargs="?", const="block", default=None,
-        choices=["block", "mlp", "off"],
+        choices=["block", "mlp", "dots", "off"],
         help="activation checkpointing ('block' = whole block, 'mlp' = MLP "
         "sublayer only; bare flag means 'block'; 'off' forces none; "
         "default: off for 124M/345M, 'mlp' for larger presets)",
+    )
+    p.add_argument(
+        "--unroll_accum", action="store_true",
+        help="unroll the grad-accumulation loop instead of lax.scan "
+        "(measured WORSE at 124M — memory pressure beats the cross-micro "
+        "overlap, PERF_ANALYSIS.md §4 — kept for sweeps on other configs)",
     )
     p.add_argument(
         "--scan_layers", default="auto", choices=["auto", "on", "off"],
@@ -86,8 +92,15 @@ def main() -> None:
     )
     if args.batch:
         micro_batch = args.batch
+    elif not on_tpu:
+        micro_batch = 2
+    elif args.model == "345M":
+        # b6 is the largest micro-batch that fits 345M WITHOUT remat on a
+        # 16G chip — and no-remat beats remat=mlp's MLP replay: 51.7% vs
+        # 48.4% MFU (round-3 sweep, PERF_ANALYSIS.md §5).
+        micro_batch = 6
     else:
-        micro_batch = (8 if small_model else 4) if on_tpu else 2
+        micro_batch = 8 if small_model else 4
     grad_accum = args.grad_accum_steps or (8 if on_tpu else 1)
     seq_len = args.seq_len if on_tpu else min(args.seq_len, 256)
     steps = args.steps if on_tpu else max(2, args.steps // 5)
@@ -104,7 +117,7 @@ def main() -> None:
 
     with activate_mesh(mesh):
         params, opt_state, _, _ = shard_params_and_opt_state(params, optimizer, mesh)
-        step = make_train_step(config, optimizer)
+        step = make_train_step(config, optimizer, unroll_accum=args.unroll_accum)
         x, y = shard_batch((x, y), mesh)
         key = jax.random.PRNGKey(0)
 

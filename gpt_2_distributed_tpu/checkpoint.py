@@ -163,6 +163,7 @@ def _restore_with_layout_migration(
             )
     out = []
     for s, t, sh in zip(flat_res, flat_tmpl, flat_shard):
+        needs_placement = unplaced  # fallback read skipped mesh placement
         if np.shape(s) != np.shape(t):
             same_data = (
                 np.size(s) == np.size(t)
@@ -175,10 +176,12 @@ def _restore_with_layout_migration(
                     f"{np.asarray(s).dtype} is incompatible with model "
                     f"shape {np.shape(t)}/{np.asarray(t).dtype}"
                 )
+            # Reshaping drops whatever placement the restore produced (this
+            # branch is reachable WITHOUT the fallback — orbax can silently
+            # return saved shapes from a sharded restore), so re-place below.
             s = np.asarray(jax.device_get(s)).reshape(np.shape(t))
-        if unplaced and sh is not None:
-            # The fallback read skipped mesh placement for EVERY leaf, not
-            # just reshaped ones — place them all.
+            needs_placement = True
+        if needs_placement and sh is not None:
             s = jax.device_put(np.asarray(jax.device_get(s)), sh)
         out.append(s)
     return jax.tree_util.tree_unflatten(treedef_tmpl, out)

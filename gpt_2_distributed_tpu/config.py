@@ -74,9 +74,10 @@ class GPT2Config:
             raise ValueError(
                 f"loss_impl={self.loss_impl!r}: expected 'blocked' or 'dense'"
             )
-        if self.remat not in (False, True, "block", "mlp"):
+        if self.remat not in (False, True, "block", "mlp", "dots"):
             raise ValueError(
-                f"remat={self.remat!r}: expected False, True, 'block' or 'mlp'"
+                f"remat={self.remat!r}: expected False, True, 'block', "
+                f"'mlp' or 'dots'"
             )
 
     @property

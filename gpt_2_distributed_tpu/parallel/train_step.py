@@ -67,6 +67,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     compute_dtype: jnp.dtype = jnp.bfloat16,
     donate: bool = True,
+    unroll_accum: bool = False,
 ) -> Callable:
     """Build the jitted train step.
 
@@ -108,11 +109,20 @@ def make_train_step(
             return (grad_acc, loss_acc + loss), None
 
         zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
-        (grad_sum, loss_sum), _ = jax.lax.scan(
-            micro_step,
-            (zero_grads, jnp.zeros((), jnp.float32)),
-            (x, y, jnp.arange(accum)),
-        )
+        carry = (zero_grads, jnp.zeros((), jnp.float32))
+        if unroll_accum:
+            # Unrolled micro-batch loop: XLA can overlap micro-batch i's
+            # loss/backward tail with micro-batch i+1's forward — the same
+            # cross-boundary scheduling win as unrolling the layer scan
+            # (PERF_ANALYSIS.md §3). HLO grows linearly in accum; use for
+            # small accum counts on the perf path.
+            for i in range(accum):
+                carry, _ = micro_step(carry, (x[i], y[i], jnp.asarray(i)))
+            grad_sum, loss_sum = carry
+        else:
+            (grad_sum, loss_sum), _ = jax.lax.scan(
+                micro_step, carry, (x, y, jnp.arange(accum)),
+            )
         # Mean over micro-batches == the reference's loss/grad_accum scaling
         # before backward (/root/reference/train_gpt2_distributed.py:409).
         grads = jax.tree_util.tree_map(lambda g: g / accum, grad_sum)

@@ -103,7 +103,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint in --save_dir")
     p.add_argument(
         "--remat", nargs="?", const="block", default=False,
-        choices=["block", "mlp"],
+        choices=["block", "mlp", "dots"],
         help="activation checkpointing: 'block' (full, lowest memory; the "
         "bare flag means this) or 'mlp' (remat only the MLP sublayer — "
         "attention runs once; the throughput sweet spot when memory allows)",

@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from gpt_2_distributed_tpu.models import gpt2
 from gpt_2_distributed_tpu.parallel.train_step import (
@@ -114,3 +115,38 @@ def test_params_stay_fp32_after_update(tiny_config, rng_np):
     new_params, _, _ = step(params, opt_state, x, y, jax.random.PRNGKey(0), 0)
     for leaf in jax.tree_util.tree_leaves(new_params):
         assert leaf.dtype == jnp.float32
+
+
+def test_unroll_accum_matches_scan(tiny_config, rng_np):
+    """The unrolled grad-accumulation path (bench --unroll_accum) computes
+    exactly what the lax.scan path computes."""
+    import jax
+    import jax.numpy as jnp
+
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    x = rng_np.integers(0, tiny_config.vocab_size, (4, 2, 16)).astype("int32")
+    y = rng_np.integers(0, tiny_config.vocab_size, (4, 2, 16)).astype("int32")
+    key = jax.random.PRNGKey(0)
+
+    def run(unroll):
+        params = gpt2.init_params(tiny_config)
+        opt = make_optimizer(1e-3)
+        opt_state = opt.init(params)
+        step = make_train_step(tiny_config, opt, compute_dtype=jnp.float32,
+                               donate=False, unroll_accum=unroll)
+        new_params, _, m = step(params, opt_state, x, y, key, 0)
+        return float(m.loss), jax.device_get(new_params)
+
+    loss_s, p_s = run(False)
+    loss_u, p_u = run(True)
+    assert loss_u == pytest.approx(loss_s, rel=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-6),
+        p_s, p_u,
+    )
