@@ -36,8 +36,9 @@ def main() -> None:
         "--remat", nargs="?", const="block", default=None,
         choices=["block", "mlp", "dots", "off"],
         help="activation checkpointing ('block' = whole block, 'mlp' = MLP "
-        "sublayer only; bare flag means 'block'; 'off' forces none; "
-        "default: off for 124M/345M, 'mlp' for larger presets)",
+        "sublayer only, 'dots' = save-matmul-outputs policy; bare flag "
+        "means 'block'; 'off' forces none; default: off for 124M/345M, "
+        "'mlp' for larger presets)",
     )
     p.add_argument(
         "--unroll_accum", action="store_true",
@@ -97,7 +98,7 @@ def main() -> None:
     elif args.model == "345M":
         # b6 is the largest micro-batch that fits 345M WITHOUT remat on a
         # 16G chip — and no-remat beats remat=mlp's MLP replay: 51.7% vs
-        # 48.4% MFU (round-3 sweep, PERF_ANALYSIS.md §5).
+        # 48.1% MFU (round-3 sweep, PERF_ANALYSIS.md §5).
         micro_batch = 6
     else:
         micro_batch = 8 if small_model else 4

@@ -105,8 +105,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--remat", nargs="?", const="block", default=False,
         choices=["block", "mlp", "dots"],
         help="activation checkpointing: 'block' (full, lowest memory; the "
-        "bare flag means this) or 'mlp' (remat only the MLP sublayer — "
-        "attention runs once; the throughput sweet spot when memory allows)",
+        "bare flag means this), 'mlp' (remat only the MLP sublayer — "
+        "attention runs once; the throughput sweet spot when memory allows) "
+        "or 'dots' (checkpoint-policy: save matmul outputs, replay only "
+        "elementwise ops — measured slower than both at 124M, situational)",
     )
     p.add_argument(
         "--loss_impl", default="blocked", choices=["blocked", "dense"],

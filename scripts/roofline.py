@@ -204,7 +204,7 @@ def main() -> None:
     params = gpt2.init_params(cfg)
     opt = optax.adamw(1e-4, b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.1)
     opt_state = opt.init(params)
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    n_params = gpt2.count_params(params)
     grads = jax.tree_util.tree_map(lambda p: jnp.ones_like(p) * 1e-6, params)
 
     @functools.partial(jax.jit, static_argnums=(3,))
@@ -231,7 +231,7 @@ def main() -> None:
     # (a) Absolute: the best sustained matmul rate — no mostly-matmul program
     #     exceeds it.
     result["model_flops_ceiling_tf"] = best_matmul
-    result["nameplate_fraction_of_ceiling"] = round(
+    result["ceiling_fraction_of_nameplate"] = round(
         best_matmul / result["nameplate_bf16_tf"], 4
     ) if result["nameplate_bf16_tf"] else None
     # (b) Shape-matched component prediction: time the bench's per-micro-batch
