@@ -47,12 +47,16 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat):
     import jax.tree_util as jtu
     import numpy as np
     from jax.experimental import topologies
-    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import NamedSharding
 
     from gpt_2_distributed_tpu.config import MODEL_PRESETS
     from gpt_2_distributed_tpu.models import gpt2
     from gpt_2_distributed_tpu.parallel import sharding as sh
-    from gpt_2_distributed_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, activate_mesh
+    from gpt_2_distributed_tpu.parallel.mesh import (
+        MeshSpec,
+        activate_mesh,
+        create_mesh,
+    )
     from gpt_2_distributed_tpu.parallel.train_step import (
         make_optimizer,
         make_train_step,
@@ -60,8 +64,10 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat):
 
     topo = topologies.get_topology_desc(platform="tpu", topology_name=topo_name)
     n = data * fsdp
-    mesh = Mesh(np.asarray(topo.devices).reshape(data, fsdp),
-                (DATA_AXIS, FSDP_AXIS))
+    # Canonical 4-axis mesh via the shared helper over the TOPOLOGY's
+    # devices (batch_pspec names the 'sp' axis since ring attention landed;
+    # a hand-rolled 2-axis mesh broke this script once already).
+    mesh = create_mesh(MeshSpec(data, fsdp), devices=list(topo.devices))
     cfg = MODEL_PRESETS[preset].replace(remat=remat)
     opt = make_optimizer(1e-4)
     params_shape = jax.eval_shape(lambda: gpt2.init_params(cfg))
