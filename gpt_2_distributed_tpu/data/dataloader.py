@@ -52,6 +52,8 @@ DEFAULT_BATCH_SIZE = 4
 DEFAULT_CONTEXT_LENGTH = 1024
 DEFAULT_NUM_WORKERS = 2
 DEFAULT_PREFETCH_FACTOR = 2
+# Windows per native gather call (see _iter_one_shard's fast path).
+_NATIVE_GATHER_CHUNK = 256
 
 
 def get_shard_paths(data_dir: str, split: str, extension: str = ".bin") -> list[str]:
@@ -197,8 +199,39 @@ class TokenShardDataset:
             random.Random(
                 _offset_seed(epoch, self.process_index, worker_id)
             ).shuffle(offsets)
-        for off in offsets[start_offset_index:]:
-            window = np.array(tokens[off : off + self.seq_len + 1], dtype=np.uint16)
+        remaining = offsets[start_offset_index:]
+        window_len = self.seq_len + 1
+
+        from gpt_2_distributed_tpu import native
+
+        if native.available() and len(remaining) > 1:
+            # Native fast path: one C call gathers a chunk of windows and
+            # range-scans them in the same pass (GIL released) — the
+            # framework's first-party replacement for the native loader
+            # machinery the reference inherits from torch (SURVEY.md §2.3).
+            # Chunk size trades call overhead against prefetch granularity.
+            for c0 in range(0, len(remaining), _NATIVE_GATHER_CHUNK):
+                chunk = np.asarray(
+                    remaining[c0 : c0 + _NATIVE_GATHER_CHUNK], dtype=np.int64
+                )
+                wins, max_id = native.gather_windows(tokens, chunk, window_len)
+                if self.vocab_size is not None and max_id >= self.vocab_size:
+                    # Error path: re-scan to name the offending offset, with
+                    # the same message contract as the numpy path.
+                    for off, win in zip(chunk, wins):
+                        top = int(win.max())
+                        if top >= self.vocab_size:
+                            raise ValueError(
+                                f"shard {path} contains token id {top} >= "
+                                f"vocab_size {self.vocab_size} (offset "
+                                f"{off}); data is corrupt or tokenized with "
+                                f"a different vocabulary"
+                            )
+                yield from wins
+            return
+
+        for off in remaining:
+            window = np.array(tokens[off : off + window_len], dtype=np.uint16)
             if self.vocab_size is not None:
                 top = int(window.max())
                 if top >= self.vocab_size:

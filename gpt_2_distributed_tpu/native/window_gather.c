@@ -1,0 +1,50 @@
+/* Native data-loader core: batched token-window gather + validation.
+ *
+ * The host-side hot path of the streaming shard loader is: for each sample,
+ * copy a (seq_len + 1)-token window out of a memmapped uint16 shard and
+ * range-check every token id (clip-mode device gathers would otherwise turn
+ * corrupt data into silently-wrong training — dataloader.py). Doing that
+ * per-window in Python costs a slice + copy + .max() round trip through the
+ * interpreter per 2 KB window, all under the GIL.
+ *
+ * This is the framework's native equivalent of the runtime the reference
+ * inherits from torch's C++ DataLoader machinery (SURVEY.md §2.3): one C
+ * call gathers a whole batch of windows and computes the running max in the
+ * same pass over each cache line. It is deliberately plain C with a
+ * ctypes-loadable ABI — no CPython API, no numpy headers — so it compiles
+ * anywhere with a C compiler and the Python layer (native/__init__.py)
+ * falls back to numpy when none exists.
+ *
+ * Returns the highest token id seen across all gathered windows (for the
+ * caller's vocab check), or -1 if any (offset + window_len) would read past
+ * n_tokens (caller bug; nothing is written for that window).
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+int64_t gather_windows(
+    const uint16_t *tokens,   /* memmapped shard base */
+    int64_t n_tokens,         /* shard length in tokens */
+    const int64_t *offsets,   /* window start offsets */
+    int64_t n_windows,
+    int64_t window_len,       /* seq_len + 1 */
+    uint16_t *out             /* [n_windows, window_len], caller-allocated */
+) {
+    uint16_t max_seen = 0;
+    for (int64_t w = 0; w < n_windows; ++w) {
+        int64_t off = offsets[w];
+        if (off < 0 || off + window_len > n_tokens) {
+            return -1;
+        }
+        const uint16_t *src = tokens + off;
+        uint16_t *dst = out + w * window_len;
+        memcpy(dst, src, (size_t)window_len * sizeof(uint16_t));
+        for (int64_t i = 0; i < window_len; ++i) {
+            if (src[i] > max_seen) {
+                max_seen = src[i];
+            }
+        }
+    }
+    return (int64_t)max_seen;
+}
