@@ -177,9 +177,14 @@ class TokenShardDataset:
         Offsets are non-overlapping with stride ``seq_len`` — consecutive
         windows share one boundary token, so every token is both an input and
         (once) a target — shuffled per (epoch, process, worker). Windows are
-        copied out of the memmap so the yielded array owns its memory
-        (``/root/reference/dataloader.py:104-133``). ``start_offset_index``
-        slices the (deterministic) shuffled offset list for arithmetic resume.
+        copied out of the memmap (``/root/reference/dataloader.py:104-133``);
+        on the native fast path the yielded arrays are rows (views) of a
+        bounded ``_NATIVE_GATHER_CHUNK``-window gather buffer rather than
+        individually-owned copies — contents and order are identical either
+        way, and the in-repo consumer (``_WorkerThread``) immediately
+        ``np.stack``-copies them into batches. Callers that retain single
+        windows long-term should copy. ``start_offset_index`` slices the
+        (deterministic) shuffled offset list for arithmetic resume.
         """
         tokens = np.memmap(path, dtype="<u2", mode="r")
         n = tokens.shape[0]

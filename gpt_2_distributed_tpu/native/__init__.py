@@ -46,19 +46,27 @@ def _compiler() -> str | None:
 
 
 def _build_and_load() -> ctypes.CDLL | None:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return ctypes.CDLL(_SO)
+    try:
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return ctypes.CDLL(_SO)
+    except OSError:
+        # Stale/foreign cached .so (other arch/glibc) or missing source:
+        # fall through to a rebuild, or to the numpy path below.
+        pass
     cc = _compiler()
     if cc is None:
         return None
-    tmp = _SO + ".tmp"
+    # Per-process tmp name: two processes building concurrently must not
+    # interleave compiler output in one file — os.replace then guarantees
+    # whichever finishes last installs a COMPLETE object.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = cc.split() + ["-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)  # atomic: concurrent builders race safely
+        os.replace(tmp, _SO)
+        return ctypes.CDLL(_SO)
     except (subprocess.SubprocessError, OSError):
         return None
-    return ctypes.CDLL(_SO)
 
 
 def _get_lib() -> ctypes.CDLL | None:

@@ -107,9 +107,17 @@ def _ring_local(
 
     row_g = idx * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
 
+    @jax.checkpoint
     def combine(k_c, v_c, m, l, acc, src):
         """One online-softmax block update of (m, l, acc) against the K/V
-        block originally owned by rank ``src``."""
+        block originally owned by rank ``src``.
+
+        Rematerialized (jax.checkpoint): without it, autodiff saves the
+        [b, h, tl, tl] score/probability blocks of EVERY ring step as
+        backward residuals — O(T^2/sp) memory, which defeats ring
+        attention's purpose at long context. With it, backward replays one
+        block (O(tl^2) transient) at ~1/3 extra attention flops — the
+        standard blockwise-attention tradeoff."""
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k_c, preferred_element_type=jnp.float32
         ) * scale
