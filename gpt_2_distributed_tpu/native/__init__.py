@@ -67,6 +67,13 @@ def _build_and_load() -> ctypes.CDLL | None:
         return ctypes.CDLL(_SO)
     except (subprocess.SubprocessError, OSError):
         return None
+    finally:
+        # A failed/timed-out compile must not leak one orphan tmp per pid.
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def _get_lib() -> ctypes.CDLL | None:

@@ -55,6 +55,10 @@ from gpt_2_distributed_tpu.ops.spmd import (
 )
 
 NEG_INF = -1e30  # same fill as the flash kernel (fp32 row-max stability)
+# KV sub-block size within one ring step (see _ring_local): bounds the live
+# score block to [b, h, tl, KV_BLOCK]. Module-level so tests can shrink it
+# to exercise multi-sub-block schedules at small shapes.
+KV_BLOCK = 1024
 
 
 def _dropout_bits_4d(seed, b_off, h_off, row_off, col_off, shape):
@@ -105,45 +109,70 @@ def _ring_local(
     h_off = shard_offset(h_shard_axes, h)
     kp = 1.0 - dropout_rate
 
-    row_g = idx * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+    # Blockwise attention inside the ring: per-device sequence blocks can
+    # grow without the forward transient growing quadratically. tl <=
+    # KV_BLOCK (or an indivisible tl) collapses to a single sub-step.
+    kv_block = min(tl, KV_BLOCK)
+    n_sub = tl // kv_block if tl % kv_block == 0 else 1
+    if n_sub == 1:
+        kv_block = tl
 
     @jax.checkpoint
     def combine(k_c, v_c, m, l, acc, src):
-        """One online-softmax block update of (m, l, acc) against the K/V
-        block originally owned by rank ``src``.
+        """One online-softmax update of (m, l, acc) against the K/V block
+        originally owned by rank ``src``, scanned over KV sub-blocks.
 
-        Rematerialized (jax.checkpoint): without it, autodiff saves the
-        [b, h, tl, tl] score/probability blocks of EVERY ring step as
-        backward residuals — O(T^2/sp) memory, which defeats ring
-        attention's purpose at long context. With it, backward replays one
-        block (O(tl^2) transient) at ~1/3 extra attention flops — the
-        standard blockwise-attention tradeoff."""
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k_c, preferred_element_type=jnp.float32
-        ) * scale
-        col_g = src * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
-        mask = col_g <= row_g                       # [tl, tl], global causal
-        s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        # Masked lanes forced to 0 (not exp(NEG_INF - m)): rows with no
-        # unmasked lane yet have m_new == NEG_INF and exp(0) would leak 1s.
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)  # [b, h, tl, tl] f32
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        if dropout_rate > 0.0:
-            bits = _dropout_bits_4d(
-                seed[0], b_off, h_off, idx * tl, src * tl, p.shape
+        Rematerialized at BOTH levels: the outer jax.checkpoint keeps the
+        scan-over-ring-steps from saving per-step residuals (O(T^2/sp)
+        without it), and the inner jax.checkpoint on ``sub`` keeps the
+        sub-block scan's VJP from stacking per-sub-block score residuals
+        back to O(tl^2) during the replay (scan VJPs save their bodies'
+        residuals across iterations — verified on the grad jaxpr). Net:
+        backward replays one sub-block at a time, O(tl x kv_block) live, at
+        ~1/3 extra attention flops — the blockwise-attention tradeoff."""
+
+        @jax.checkpoint
+        def sub(carry, args):
+            m, l, acc = carry
+            k_b, v_b, sub_i = args                 # [b, kv_block, h, d]
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k_b, preferred_element_type=jnp.float32
+            ) * scale                              # [b, h, tl, kv_block]
+            col0 = src * tl + sub_i * kv_block
+            col_g = col0 + jax.lax.broadcasted_iota(
+                jnp.int32, (tl, kv_block), 1)
+            row_b = idx * tl + jax.lax.broadcasted_iota(
+                jnp.int32, (tl, kv_block), 0)
+            mask = col_g <= row_b                  # global causal
+            s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            # Masked lanes forced to 0 (not exp(NEG_INF - m)): rows with no
+            # unmasked lane yet have m_new == NEG_INF, exp(0) would leak 1s.
+            p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            if dropout_rate > 0.0:
+                bits = _dropout_bits_4d(
+                    seed[0], b_off, h_off, idx * tl, col0, p.shape
+                )
+                threshold = jnp.uint32(int(dropout_rate * (2**32)))
+                # Torch semantics via the flash kernel's identity: drop +
+                # rescale the unnormalized exponentials, divide by the
+                # UNdropped row sum.
+                p = jnp.where(bits >= threshold, p / kp, 0.0)
+            alpha_bthd = alpha.transpose(0, 2, 1, 3)  # [b, tl, h, 1]
+            acc = acc * alpha_bthd + jnp.einsum(
+                "bhqk,bkhd->bqhd", p.astype(v_b.dtype), v_b,
+                preferred_element_type=jnp.float32,
             )
-            threshold = jnp.uint32(int(dropout_rate * (2**32)))
-            # Torch semantics via the flash kernel's identity: drop + rescale
-            # the unnormalized exponentials, divide by the UNdropped row sum.
-            p = jnp.where(bits >= threshold, p / kp, 0.0)
-        alpha_bthd = alpha.transpose(0, 2, 1, 3)     # [b, tl, h, 1]
-        acc = acc * alpha_bthd + jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(v_c.dtype), v_c,
-            preferred_element_type=jnp.float32,
+            return (m_new, l, acc), None
+
+        k_sub = k_c.reshape(b, n_sub, kv_block, h, d).transpose(1, 0, 2, 3, 4)
+        v_sub = v_c.reshape(b, n_sub, kv_block, h, d).transpose(1, 0, 2, 3, 4)
+        (m, l, acc), _ = jax.lax.scan(
+            sub, (m, l, acc), (k_sub, v_sub, jnp.arange(n_sub))
         )
-        return m_new, l, acc
+        return m, l, acc
 
     def body(carry, r):
         # Rotate at the TOP: step r receives the block from r hops back, and
