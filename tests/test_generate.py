@@ -1,0 +1,91 @@
+"""Autoregressive generation (beyond-parity: the reference is train-only)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpt_2_distributed_tpu.models import gpt2
+from gpt_2_distributed_tpu.models.generate import generate
+from gpt_2_distributed_tpu.parallel.train_step import (
+    make_optimizer,
+    make_train_step,
+)
+
+
+def test_greedy_is_deterministic(tiny_config):
+    params = gpt2.init_params(tiny_config)
+    prompt = jnp.asarray([[1, 2, 3], [7, 8, 9]], jnp.int32)
+    a = generate(params, tiny_config, prompt, jax.random.PRNGKey(0),
+                 max_new_tokens=8, temperature=0.0)
+    b = generate(params, tiny_config, prompt, jax.random.PRNGKey(5),
+                 max_new_tokens=8, temperature=0.0)
+    assert a.shape == (2, 11)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Prompt preserved.
+    np.testing.assert_array_equal(np.asarray(a[:, :3]), np.asarray(prompt))
+
+
+def test_sampling_respects_rng_and_top_k(tiny_config):
+    params = gpt2.init_params(tiny_config)
+    prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+    s1 = generate(params, tiny_config, prompt, jax.random.PRNGKey(1),
+                  max_new_tokens=16, temperature=1.0)
+    s2 = generate(params, tiny_config, prompt, jax.random.PRNGKey(1),
+                  max_new_tokens=16, temperature=1.0)
+    s3 = generate(params, tiny_config, prompt, jax.random.PRNGKey(2),
+                  max_new_tokens=16, temperature=1.0)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert not np.array_equal(np.asarray(s1), np.asarray(s3))
+    # top_k=1 == greedy regardless of rng.
+    g = generate(params, tiny_config, prompt, jax.random.PRNGKey(3),
+                 max_new_tokens=8, temperature=0.0)
+    k1 = generate(params, tiny_config, prompt, jax.random.PRNGKey(4),
+                  max_new_tokens=8, temperature=1.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(k1))
+
+
+def test_length_guard(tiny_config):
+    params = gpt2.init_params(tiny_config)
+    prompt = jnp.zeros((1, tiny_config.n_positions - 2), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds n_positions"):
+        generate(params, tiny_config, prompt, jax.random.PRNGKey(0),
+                 max_new_tokens=8)
+
+
+def test_trained_model_continues_the_pattern(tiny_config):
+    """End-to-end train -> generate: after fitting the ascending-run task
+    (next token = current + 1 mod vocab), greedy decoding must continue a
+    run correctly — the framework's first full train-then-sample loop."""
+    cfg = tiny_config
+    params = gpt2.init_params(cfg)
+    opt = make_optimizer(5e-3)
+    opt_state = opt.init(params)
+    step = make_train_step(cfg, opt, compute_dtype=jnp.float32, donate=False)
+    rng_np = np.random.default_rng(0)
+    key = jax.random.PRNGKey(0)
+    # 250 steps reach loss ~1e-3 on this task (calibrated; 60 plateau ~2.5).
+    for i in range(250):
+        starts = rng_np.integers(0, cfg.vocab_size, (8, 1))
+        seqs = (starts + np.arange(33)) % cfg.vocab_size
+        x = seqs[:, :-1].astype(np.int32)[None]
+        y = seqs[:, 1:].astype(np.int32)[None]
+        params, opt_state, m = step(params, opt_state, x, y, key, i)
+    assert float(m.loss) < 0.1, f"tiny model failed to fit: {float(m.loss)}"
+
+    prompt = jnp.asarray([[10, 11, 12, 13]], jnp.int32)
+    out = np.asarray(generate(
+        params, cfg, prompt, jax.random.PRNGKey(0),
+        max_new_tokens=6, temperature=0.0,
+    ))[0]
+    expect = (np.arange(10, 20)) % cfg.vocab_size
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_top_k_bounds_rejected(tiny_config):
+    params = gpt2.init_params(tiny_config)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    for bad in (0, tiny_config.vocab_size + 1):
+        with pytest.raises(ValueError, match="top_k"):
+            generate(params, tiny_config, prompt, jax.random.PRNGKey(0),
+                     max_new_tokens=4, top_k=bad)
