@@ -8,10 +8,12 @@ minimal TPU-idiomatic version:
 * **Static shapes throughout**: the context buffer is padded to a fixed
   ``max_len`` and the decode loop is a ``lax.scan`` over step indices with
   ``dynamic_update_slice`` writes — one compile, no per-step retracing.
-* **Full re-forward per step** (O(T) forwards of O(T^2) attention). For the
-  model sizes and prompt lengths this framework trains, that costs
-  milliseconds; a KV-cache decode path is a further optimization, not a
-  capability gap, and would thread cache state through
+* **Full re-forward per step** (O(T) forwards of O(T^2) attention), but the
+  lm_head runs on ONE sliced position per step ([B, 1, C] against the tied
+  embedding via ``gpt2.hidden_states``), never on full-sequence full-vocab
+  logits. For the model sizes and prompt lengths this framework trains,
+  that costs milliseconds; a KV-cache decode path is a further
+  optimization, not a capability gap, and would thread cache state through
   ``models/gpt2.forward``.
 * Sampling: greedy (``temperature=0``), temperature, and optional top-k —
   all inside the scanned step, driven by a JAX PRNG key.
@@ -67,13 +69,16 @@ def generate(
 
     def step(carry, t):
         ids, key = carry
-        logits, _ = gpt2.forward(
-            params, config, ids, deterministic=True, return_logits=True,
-        )
         # Next-token distribution comes from position t-1 (causal forward:
-        # depends only on ids[:, :t]).
-        logits_t = jax.lax.dynamic_slice_in_dim(
-            logits, t - 1, 1, axis=1
+        # depends only on ids[:, :t]). The hidden state is sliced BEFORE the
+        # tied-head contraction, so only a [B, 1, C] row hits the [*, vocab]
+        # matmul — not [B, total, V] fp32 logits (~200 MB/row at 124M/1024)
+        # that would be built per step just to read one position.
+        h = gpt2.hidden_states(params, config, ids, deterministic=True)
+        h_t = jax.lax.dynamic_slice_in_dim(h, t - 1, 1, axis=1)  # [B, 1, C]
+        logits_t = jnp.einsum(
+            "btc,vc->btv", h_t, params["wte"].astype(h_t.dtype),
+            preferred_element_type=jnp.float32,
         )[:, 0]                                      # [B, V] fp32
         if top_k is not None:
             # kth-largest via lax.top_k — no full-vocab sort per decode step.

@@ -232,28 +232,22 @@ def _block(
     return mlp(config, x, bp, r_mlp, deterministic)
 
 
-def forward(
+def hidden_states(
     params: Params,
     config: GPT2Config,
     idx: jnp.ndarray,  # [B, T] int token ids
-    labels: jnp.ndarray | None = None,  # [B, T] next-token ids, -100 = ignore
     *,
     rng: jax.Array | None = None,
     deterministic: bool = True,
     compute_dtype: jnp.dtype = jnp.bfloat16,
-    return_logits: bool = False,
-) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
-    """Forward pass. Returns ``(logits [B,T,V] fp32 | None, loss fp32 | None)``.
+) -> jnp.ndarray:
+    """Backbone forward: embeddings -> block stack -> final LayerNorm.
 
-    When ``labels`` are given and ``return_logits`` is False (the training
-    path), the loss comes from the blocked cross-entropy — full ``[B,T,V]``
-    logits are never materialized (``ops/losses.py``), and ``None`` is
-    returned in their place. Inference (``labels=None``) always returns
-    logits.
-
-    Sequence-length guard matches the reference's hard error beyond
-    n_positions (``/root/reference/model.py:291-292``) — here it is a trace-time
-    (static-shape) check, which is the XLA-native place for it.
+    Returns the [B, T, C] final hidden states in ``compute_dtype`` — the
+    input to the tied lm_head. Exposed separately so callers that need
+    logits for only a few positions (autoregressive decode,
+    ``models/generate.py``) can slice before the [*, vocab] contraction
+    instead of materializing full-vocab logits for every position.
     """
     b, t = idx.shape
     if t > config.n_positions:
@@ -302,7 +296,38 @@ def forward(
             blk = jax.checkpoint(_block, static_argnums=(0, 4)) if full_remat else _block
             x = blk(config, x, bp, lr, deterministic)
 
-    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
+    return layer_norm(
+        x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps
+    )
+
+
+def forward(
+    params: Params,
+    config: GPT2Config,
+    idx: jnp.ndarray,  # [B, T] int token ids
+    labels: jnp.ndarray | None = None,  # [B, T] next-token ids, -100 = ignore
+    *,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    return_logits: bool = False,
+) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
+    """Forward pass. Returns ``(logits [B,T,V] fp32 | None, loss fp32 | None)``.
+
+    When ``labels`` are given and ``return_logits`` is False (the training
+    path), the loss comes from the blocked cross-entropy — full ``[B,T,V]``
+    logits are never materialized (``ops/losses.py``), and ``None`` is
+    returned in their place. Inference (``labels=None``) always returns
+    logits.
+
+    Sequence-length guard matches the reference's hard error beyond
+    n_positions (``/root/reference/model.py:291-292``) — here it is a trace-time
+    (static-shape) check, which is the XLA-native place for it.
+    """
+    x = hidden_states(
+        params, config, idx,
+        rng=rng, deterministic=deterministic, compute_dtype=compute_dtype,
+    )
 
     wte = params["wte"].astype(compute_dtype)
     if labels is not None and not return_logits and config.loss_impl == "blocked":
