@@ -1,3 +1,3 @@
 from gpt_2_distributed_tpu.models import gpt2
 
-__all__ = ["gpt2"]
+__all__ = ["gpt2"]  # generate / decode import lazily (they pull in sampling deps)

@@ -1,0 +1,217 @@
+"""KV-cache autoregressive decoding.
+
+The reference has no inference path at all (its ``model.py`` is train-only);
+``models/generate.py`` added the minimal re-forward sampler. This module is
+the production decode path: O(T) total attention work per generated token
+instead of O(T^2), via a static key/value cache — designed TPU-first:
+
+* **Static shapes everywhere.** The cache is allocated once at
+  ``[L, B, H, total, D]`` and written with ``dynamic_update_slice``; the
+  decode loop is a ``lax.scan`` over step indices. One compile per
+  (batch, prompt, total) signature, no retracing, no growing tensors.
+* **Prefill + decode split**, the standard serving structure: the prompt
+  runs through the normal block stack once (full-sequence attention,
+  reusing the training code path), emitting the per-layer K/V it computed
+  anyway; each decode step then processes ONE token row ([B, 1, C]) against
+  the cache.
+* **Layer-stacked cache** mirrors the parameter pytree's ``[L, ...]``
+  stacking, so the per-layer decode runs as a ``lax.scan`` over layers —
+  HLO constant in depth, like the training forward.
+* Decode attention masks cache positions ``> t`` with the reference's -1e4
+  fill (``/root/reference/model.py:144`` — unwritten cache slots are zeros
+  and the mask removes them exactly: after the fp32 softmax's max-subtract,
+  ``exp(-1e4 - m)`` underflows to 0).
+
+Deterministic (no dropout) — matching eval-mode inference; sampling
+temperature/top-k semantics are shared with ``models/generate.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from gpt_2_distributed_tpu.config import GPT2Config
+from gpt_2_distributed_tpu.models import gpt2
+from gpt_2_distributed_tpu.ops.attention import MASK_VALUE, select_attention_impl
+from gpt_2_distributed_tpu.ops.layers import layer_norm
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, H, S, D] compute dtype
+    v: jnp.ndarray  # [L, B, H, S, D]
+
+
+def _prefill(
+    params,
+    config: GPT2Config,
+    prompt: jnp.ndarray,  # [B, P] int32
+    total: int,
+    compute_dtype: jnp.dtype,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run the prompt through the block stack once; return the final-position
+    hidden state [B, C] and a cache of size ``total`` holding K/V for
+    positions [0, P).
+
+    Mirrors ``gpt2.hidden_states`` (same sublayer math, deterministic) but
+    captures each layer's K/V projection instead of discarding it.
+    """
+    b, p = prompt.shape
+    h, d = config.n_head, config.head_dim
+
+    tok = params["wte"].astype(compute_dtype).at[prompt].get(mode="clip")
+    x = tok + params["wpe"].astype(compute_dtype)[:p]
+    attn_fn = select_attention_impl(config.attention_impl, p)
+
+    def body(x, bp):
+        y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
+        q, k, v = gpt2.qkv_proj(config, y, bp)      # [B, P, H, D]
+        o = attn_fn(q, k, v, deterministic=True)
+        o = o.reshape(b, p, config.n_embd)
+        o = o @ bp["attn_proj_w"].astype(x.dtype) + bp["attn_proj_b"].astype(x.dtype)
+        x = x + o
+        x = gpt2._mlp_sublayer(config, x, bp, None, True)
+        # Cache layout is [B, H, S, D] (attention-major); pad S to `total`.
+        kc = jnp.zeros((b, h, total, d), compute_dtype).at[:, :, :p].set(
+            k.transpose(0, 2, 1, 3)
+        )
+        vc = jnp.zeros((b, h, total, d), compute_dtype).at[:, :, :p].set(
+            v.transpose(0, 2, 1, 3)
+        )
+        return x, (kc, vc)
+
+    x, (kcs, vcs) = jax.lax.scan(body, x, params["block"])
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
+    return x[:, -1], KVCache(k=kcs, v=vcs)
+
+
+def decode_step(
+    params,
+    config: GPT2Config,
+    token: jnp.ndarray,  # [B] int32 — token at position `pos`
+    pos: jnp.ndarray,    # scalar int32 position of `token`
+    cache: KVCache,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Process one token against the cache. Returns (logits [B, V] fp32,
+    cache with K/V written at ``pos``). Attention covers cache positions
+    ``<= pos`` only."""
+    b = token.shape[0]
+    c, h, d = config.n_embd, config.n_head, config.head_dim
+    total = cache.k.shape[3]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    tok = params["wte"].astype(compute_dtype).at[token].get(mode="clip")
+    wpe = jax.lax.dynamic_slice_in_dim(
+        params["wpe"].astype(compute_dtype), pos, 1, axis=0
+    )
+    x = tok[:, None] + wpe[None]  # [B, 1, C]
+
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (1, total), 1)
+    mask = kpos <= pos  # [1, total]
+
+    def body(x, layer):
+        bp, kc, vc = layer  # kc/vc: [B, H, S, D]
+        y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
+        q, k, v = gpt2.qkv_proj(config, y, bp)       # [B, 1, H, D]
+        kc = jax.lax.dynamic_update_slice_in_dim(
+            kc, k.transpose(0, 2, 1, 3), pos, axis=2
+        )
+        vc = jax.lax.dynamic_update_slice_in_dim(
+            vc, v.transpose(0, 2, 1, 3), pos, axis=2
+        )
+        qh = q.transpose(0, 2, 1, 3)                 # [B, H, 1, D]
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh, kc, preferred_element_type=jnp.float32
+        ) * scale                                     # [B, H, 1, S]
+        scores = jnp.where(mask[None, None], scores, MASK_VALUE)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vc)
+        o = o.transpose(0, 2, 1, 3).reshape(b, 1, c)
+        o = o @ bp["attn_proj_w"].astype(x.dtype) + bp["attn_proj_b"].astype(x.dtype)
+        x = x + o
+        x = gpt2._mlp_sublayer(config, x, bp, None, True)
+        return x, (kc, vc)
+
+    x, (kcs, vcs) = jax.lax.scan(body, x, (params["block"], cache.k, cache.v))
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
+    logits = jnp.einsum(
+        "btc,vc->btv", x, params["wte"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )[:, 0]                                           # [B, V] fp32
+    return logits, KVCache(k=kcs, v=vcs)
+
+
+def _sample(logits, key, temperature: float, top_k: int | None):
+    """Greedy (temperature=0) / temperature / top-k sampling — the same
+    semantics as models/generate.py, shared trace-time branches."""
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "max_new_tokens", "temperature", "top_k",
+                     "compute_dtype"),
+)
+def generate_cached(
+    params,
+    config: GPT2Config,
+    prompt: jnp.ndarray,       # [B, P] int32
+    rng: jax.Array,
+    max_new_tokens: int = 32,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """KV-cached sampling: same signature and sampling semantics as
+    ``generate.generate`` (identical greedy outputs, same PRNG split order),
+    O(total) attention per new token instead of a full re-forward."""
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if total > config.n_positions:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"n_positions ({config.n_positions})"
+        )
+    if top_k is not None and not (1 <= top_k <= config.vocab_size):
+        raise ValueError(
+            f"top_k={top_k} must be in [1, vocab_size={config.vocab_size}]"
+        )
+
+    h_last, cache = _prefill(params, config, prompt, total, compute_dtype)
+    logits0 = jnp.einsum(
+        "bc,vc->bv", h_last, params["wte"].astype(h_last.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    key, sub = jax.random.split(rng)
+    first = _sample(logits0, sub, temperature, top_k)
+
+    ids = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
+    ids = ids.at[:, p].set(first) if max_new_tokens > 0 else ids
+
+    def step(carry, t):
+        ids, cache, key = carry
+        # Process the just-placed token at t-1 (writes its K/V), sample ids[t].
+        tok = jax.lax.dynamic_slice_in_dim(ids, t - 1, 1, axis=1)[:, 0]
+        logits, cache = decode_step(
+            params, config, tok, t - 1, cache, compute_dtype
+        )
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, sub, temperature, top_k)
+        ids = jax.lax.dynamic_update_slice_in_dim(
+            ids, nxt[:, None], t, axis=1
+        )
+        return (ids, cache, key), None
+
+    (ids, _, _), _ = jax.lax.scan(
+        step, (ids, cache, key), jnp.arange(p + 1, total)
+    )
+    return ids

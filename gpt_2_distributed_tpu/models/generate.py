@@ -12,9 +12,10 @@ minimal TPU-idiomatic version:
   lm_head runs on ONE sliced position per step ([B, 1, C] against the tied
   embedding via ``gpt2.hidden_states``), never on full-sequence full-vocab
   logits. For the model sizes and prompt lengths this framework trains,
-  that costs milliseconds; a KV-cache decode path is a further
-  optimization, not a capability gap, and would thread cache state through
-  ``models/gpt2.forward``.
+  that costs milliseconds. The production KV-cache prefill+decode path
+  lives in ``models/decode.py`` (``generate_cached`` — same signature and
+  sampling semantics); this module stays as the simplest-possible sampler
+  and the reference implementation the cache path is tested against.
 * Sampling: greedy (``temperature=0``), temperature, and optional top-k —
   all inside the scanned step, driven by a JAX PRNG key.
 
@@ -36,7 +37,8 @@ from gpt_2_distributed_tpu.models import gpt2
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "max_new_tokens", "temperature", "top_k"),
+    static_argnames=("config", "max_new_tokens", "temperature", "top_k",
+                     "compute_dtype"),
 )
 def generate(
     params,
@@ -46,6 +48,7 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 1.0,
     top_k: int | None = None,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
 ) -> jnp.ndarray:
     """Sample ``max_new_tokens`` continuations. Returns [B, P + new] ids.
 
@@ -74,7 +77,10 @@ def generate(
         # tied-head contraction, so only a [B, 1, C] row hits the [*, vocab]
         # matmul — not [B, total, V] fp32 logits (~200 MB/row at 124M/1024)
         # that would be built per step just to read one position.
-        h = gpt2.hidden_states(params, config, ids, deterministic=True)
+        h = gpt2.hidden_states(
+            params, config, ids, deterministic=True,
+            compute_dtype=compute_dtype,
+        )
         h_t = jax.lax.dynamic_slice_in_dim(h, t - 1, 1, axis=1)  # [B, 1, C]
         logits_t = jnp.einsum(
             "btc,vc->btv", h_t, params["wte"].astype(h_t.dtype),

@@ -120,6 +120,48 @@ def count_params(params: Params) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(params))
 
 
+def qkv_proj(
+    config: GPT2Config,
+    y: jnp.ndarray,  # [B, T, C] post-ln1, compute dtype
+    bp: dict[str, jnp.ndarray],  # one layer's params
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused qkv projection -> (q, k, v), each [B, T, H, D].
+
+    q/k/v stay in [B, T, H, D] — the flash kernel transposes at its own
+    boundary where XLA can fold the permute into the reshape (the
+    reference's permute at model.py:124-129 is a layout copy on GPU).
+    The weight is STORED head-explicit [C, 3, H, D] so tensor parallelism
+    can shard the head axis (see init_params). Compute-side there are two
+    equivalent contractions:
+     * tp inactive: flatten the weight to [C, 3C] and run one plain matmul
+       (measured ~6% faster whole-step on v5e than the head-explicit
+       einsum — XLA picks a better layout for the flat form);
+     * tp active: the flatten would merge the sharded H axis into an
+       unshardable merged dim (full re-gather), so contract head-explicit
+       and let GSPMD keep q/k/v head-sharded end to end.
+
+    Shared by the training forward and the KV-cache decode path
+    (``models/decode.py``), which calls it with T=1 token rows.
+    """
+    cdt = y.dtype
+    b_, t_, c = y.shape
+    h_, d_ = config.n_head, config.head_dim
+    if _tp_active():
+        qkv = jnp.einsum(
+            "btc,cshd->btshd", y, bp["attn_qkv_w"].astype(cdt)
+        ) + bp["attn_qkv_b"].astype(cdt)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    w2 = bp["attn_qkv_w"].astype(cdt).reshape(c, 3 * c)
+    b2 = bp["attn_qkv_b"].astype(cdt).reshape(3 * c)
+    qkv = y @ w2 + b2
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return (
+        q.reshape(b_, t_, h_, d_),
+        k.reshape(b_, t_, h_, d_),
+        v.reshape(b_, t_, h_, d_),
+    )
+
+
 def _attn_sublayer(
     config: GPT2Config,
     x: jnp.ndarray,  # [B, T, C] in compute dtype
@@ -135,35 +177,8 @@ def _attn_sublayer(
     else:
         r_attn = r_aresid = None
 
-    # q/k/v stay in [B, T, H, D] — the flash kernel transposes at its own
-    # boundary where XLA can fold the permute into the reshape (the
-    # reference's permute at model.py:124-129 is a layout copy on GPU).
-    # The weight is STORED head-explicit [C, 3, H, D] so tensor parallelism
-    # can shard the head axis (see init_params). Compute-side there are two
-    # equivalent contractions:
-    #  * tp inactive: flatten the weight to [C, 3C] and run one plain matmul
-    #    (measured ~6% faster whole-step on v5e than the head-explicit
-    #    einsum — XLA picks a better layout for the flat form);
-    #  * tp active: the flatten would merge the sharded H axis into an
-    #    unshardable merged dim (full re-gather), so contract head-explicit
-    #    and let GSPMD keep q/k/v head-sharded end to end.
-    b_, t_, h_, d_ = x.shape[0], x.shape[1], config.n_head, config.head_dim
     y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
-    if _tp_active():
-        qkv = jnp.einsum(
-            "btc,cshd->btshd", y, bp["attn_qkv_w"].astype(cdt)
-        ) + bp["attn_qkv_b"].astype(cdt)
-        q = qkv[:, :, 0]
-        k = qkv[:, :, 1]
-        v = qkv[:, :, 2]
-    else:
-        w2 = bp["attn_qkv_w"].astype(cdt).reshape(c, 3 * c)
-        b2 = bp["attn_qkv_b"].astype(cdt).reshape(3 * c)
-        qkv = y @ w2 + b2
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b_, t_, h_, d_)
-        k = k.reshape(b_, t_, h_, d_)
-        v = v.reshape(b_, t_, h_, d_)
+    q, k, v = qkv_proj(config, y, bp)
     attn_fn = select_attention_impl(config.attention_impl, t)
     o = attn_fn(
         q, k, v,

@@ -66,6 +66,41 @@ def test_bf16_inputs_fp32_loss():
     np.testing.assert_allclose(float(a), float(b), rtol=2e-3)
 
 
+def test_bf16_logit_rounding_matches_autocast_semantics():
+    """For bf16 inputs the blocked CE rounds chunk logits to bf16 exactly
+    once before the fp32 log-softmax — torch autocast's dtype sequence
+    (bf16 lm_head output, F.cross_entropy upcasts internally). Against a
+    dense reference with the same single rounding, agreement must be far
+    tighter than vs the unrounded dense path (test above): only the blocked
+    LSE accumulation order differs."""
+    x, wte, labels = make_data(masked=7)
+    xb, wb = x.astype(jnp.bfloat16), wte.astype(jnp.bfloat16)
+
+    logits = jnp.einsum("nc,vc->nv", xb, wb).astype(jnp.float32)  # one bf16 rounding
+    a = blocked_cross_entropy(xb, wb, labels, 64)
+    b = cross_entropy(logits[None], labels[None])
+    # Chunked vs dense contraction shapes may order the fp32 accumulation
+    # differently -> occasional 1-ulp bf16 output differences feeding the
+    # LSE; 2e-5 absorbs that while staying ~100x tighter than the
+    # vs-unrounded-dense bound above (rtol 2e-3).
+    np.testing.assert_allclose(float(a), float(b), rtol=2e-5)
+
+    # Gradients flow through the same rounded logits and the input-dtype
+    # backward matmuls; check dx against the dense autograd at bf16-level
+    # tolerance (the dense path's dx accumulates in bf16 epsilon too).
+    ga = jax.grad(lambda x: blocked_cross_entropy(x, wb, labels, 64))(xb)
+    gb = jax.grad(
+        lambda x: cross_entropy(
+            jnp.einsum("nc,vc->nv", x, wb).astype(jnp.float32)[None],
+            labels[None],
+        )
+    )(xb)
+    np.testing.assert_allclose(
+        np.asarray(ga, np.float32), np.asarray(gb, np.float32),
+        atol=1e-7, rtol=2e-2,
+    )
+
+
 def test_forward_training_path_matches_logits_path(tiny_config, rng_np):
     """gpt2.forward's blocked-CE training path == its dense logits path."""
     from gpt_2_distributed_tpu.models import gpt2
