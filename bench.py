@@ -30,7 +30,7 @@ def main() -> None:
     p.add_argument("--seq_len", type=int, default=1024)
     p.add_argument("--batch", type=int, default=0, help="micro-batch per chip; 0 = auto")
     p.add_argument("--grad_accum_steps", type=int, default=0, help="0 = auto")
-    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument(
         "--remat", nargs="?", const="block", default=None,

@@ -36,6 +36,10 @@ import jax.numpy as jnp
 
 from gpt_2_distributed_tpu.config import GPT2Config
 from gpt_2_distributed_tpu.models import gpt2
+from gpt_2_distributed_tpu.models.generate import (
+    check_generation_args,
+    sample_token,
+)
 from gpt_2_distributed_tpu.ops.attention import MASK_VALUE, select_attention_impl
 from gpt_2_distributed_tpu.ops.layers import layer_norm
 
@@ -145,17 +149,6 @@ def decode_step(
     return logits, KVCache(k=kcs, v=vcs)
 
 
-def _sample(logits, key, temperature: float, top_k: int | None):
-    """Greedy (temperature=0) / temperature / top-k sampling — the same
-    semantics as models/generate.py, shared trace-time branches."""
-    if top_k is not None:
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
-
-
 @functools.partial(
     jax.jit,
     static_argnames=("config", "max_new_tokens", "temperature", "top_k",
@@ -175,16 +168,7 @@ def generate_cached(
     ``generate.generate`` (identical greedy outputs, same PRNG split order),
     O(total) attention per new token instead of a full re-forward."""
     b, p = prompt.shape
-    total = p + max_new_tokens
-    if total > config.n_positions:
-        raise ValueError(
-            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"n_positions ({config.n_positions})"
-        )
-    if top_k is not None and not (1 <= top_k <= config.vocab_size):
-        raise ValueError(
-            f"top_k={top_k} must be in [1, vocab_size={config.vocab_size}]"
-        )
+    total = check_generation_args(config, p, max_new_tokens, top_k)
 
     h_last, cache = _prefill(params, config, prompt, total, compute_dtype)
     logits0 = jnp.einsum(
@@ -192,7 +176,7 @@ def generate_cached(
         preferred_element_type=jnp.float32,
     )
     key, sub = jax.random.split(rng)
-    first = _sample(logits0, sub, temperature, top_k)
+    first = sample_token(logits0, sub, temperature, top_k)
 
     ids = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
     ids = ids.at[:, p].set(first) if max_new_tokens > 0 else ids
@@ -205,7 +189,7 @@ def generate_cached(
             params, config, tok, t - 1, cache, compute_dtype
         )
         key, sub = jax.random.split(key)
-        nxt = _sample(logits, sub, temperature, top_k)
+        nxt = sample_token(logits, sub, temperature, top_k)
         ids = jax.lax.dynamic_update_slice_in_dim(
             ids, nxt[:, None], t, axis=1
         )

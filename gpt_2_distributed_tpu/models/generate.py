@@ -35,6 +35,38 @@ from gpt_2_distributed_tpu.config import GPT2Config
 from gpt_2_distributed_tpu.models import gpt2
 
 
+def sample_token(logits, key, temperature: float, top_k: int | None):
+    """Greedy (temperature=0) / temperature / top-k sampling on [B, V] fp32
+    logits -> [B] int32. THE sampling semantics for both decode paths: the
+    KV-cache sampler (models/decode.py) imports this so the two can never
+    drift apart (their exact-equality contract is tested in
+    tests/test_decode.py)."""
+    if top_k is not None:
+        # kth-largest via lax.top_k — no full-vocab sort per decode step.
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+def check_generation_args(
+    config: GPT2Config, prompt_len: int, max_new_tokens: int, top_k: int | None
+) -> int:
+    """Shared trace-time validation; returns the total sequence length."""
+    total = prompt_len + max_new_tokens
+    if total > config.n_positions:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds n_positions ({config.n_positions})"
+        )
+    if top_k is not None and not (1 <= top_k <= config.vocab_size):
+        raise ValueError(
+            f"top_k={top_k} must be in [1, vocab_size={config.vocab_size}]"
+        )
+    return total
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("config", "max_new_tokens", "temperature", "top_k",
@@ -56,16 +88,7 @@ def generate(
     sampling to the k highest-probability tokens.
     """
     b, p = prompt.shape
-    total = p + max_new_tokens
-    if total > config.n_positions:
-        raise ValueError(
-            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"n_positions ({config.n_positions})"
-        )
-    if top_k is not None and not (1 <= top_k <= config.vocab_size):
-        raise ValueError(
-            f"top_k={top_k} must be in [1, vocab_size={config.vocab_size}]"
-        )
+    total = check_generation_args(config, p, max_new_tokens, top_k)
     # Fixed-size context buffer; unwritten tail is zeros (never attended to
     # by any position we read logits from).
     ids = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
@@ -86,17 +109,10 @@ def generate(
             "btc,vc->btv", h_t, params["wte"].astype(h_t.dtype),
             preferred_element_type=jnp.float32,
         )[:, 0]                                      # [B, V] fp32
-        if top_k is not None:
-            # kth-largest via lax.top_k — no full-vocab sort per decode step.
-            kth = jax.lax.top_k(logits_t, top_k)[0][:, -1:]
-            logits_t = jnp.where(logits_t < kth, -jnp.inf, logits_t)
         key, sub = jax.random.split(key)
-        if temperature == 0.0:
-            nxt = jnp.argmax(logits_t, axis=-1)
-        else:
-            nxt = jax.random.categorical(sub, logits_t / temperature, axis=-1)
+        nxt = sample_token(logits_t, sub, temperature, top_k)
         ids = jax.lax.dynamic_update_slice_in_dim(
-            ids, nxt[:, None].astype(jnp.int32), t, axis=1
+            ids, nxt[:, None], t, axis=1
         )
         return (ids, key), None
 
