@@ -161,25 +161,33 @@ def _restore_with_layout_migration(
                 f"shardings tree has {len(flat_shard)} leaves but the "
                 f"template has {len(flat_tmpl)}; cannot align"
             )
+    # Shape/dtype introspection that prefers attributes over np.asarray so
+    # abstract templates (jax.ShapeDtypeStruct from eval_shape — the cheap
+    # way to build a params-only restore target) work alongside real arrays:
+    # np.size/np.ndim/np.asarray silently misread an SDS as an object scalar.
+    lshape = lambda x: tuple(getattr(x, "shape", None) or np.shape(x))
+    ldtype = lambda x: np.dtype(getattr(x, "dtype", None) or np.asarray(x).dtype)
+    lsize = lambda x: int(np.prod(lshape(x), dtype=np.int64))
+
     out = []
     for s, t, sh in zip(flat_res, flat_tmpl, flat_shard):
         needs_placement = unplaced  # fallback read skipped mesh placement
-        if np.shape(s) != np.shape(t):
+        if lshape(s) != lshape(t):
             same_data = (
-                np.size(s) == np.size(t)
-                and np.asarray(s).dtype == np.asarray(t).dtype
-                and np.ndim(s) != np.ndim(t)
+                lsize(s) == lsize(t)
+                and ldtype(s) == ldtype(t)
+                and len(lshape(s)) != len(lshape(t))
             )
             if not same_data:
                 raise ValueError(
-                    f"checkpoint leaf shape {np.shape(s)}/"
-                    f"{np.asarray(s).dtype} is incompatible with model "
-                    f"shape {np.shape(t)}/{np.asarray(t).dtype}"
+                    f"checkpoint leaf shape {lshape(s)}/"
+                    f"{ldtype(s)} is incompatible with model "
+                    f"shape {lshape(t)}/{ldtype(t)}"
                 )
             # Reshaping drops whatever placement the restore produced (this
             # branch is reachable WITHOUT the fallback — orbax can silently
             # return saved shapes from a sharded restore), so re-place below.
-            s = np.asarray(jax.device_get(s)).reshape(np.shape(t))
+            s = np.asarray(jax.device_get(s)).reshape(lshape(t))
             needs_placement = True
         if needs_placement and sh is not None:
             s = jax.device_put(np.asarray(jax.device_get(s)), sh)
@@ -198,6 +206,23 @@ def restore_checkpoint(
     placing arrays directly onto the mesh when shardings are given — the
     restore the reference declared but never implemented
     (``/root/reference/train_gpt2_distributed.py:104-111``)."""
+    params, meta = restore_params(path, params_template, param_shardings)
+    with ocp.StandardCheckpointer() as ckptr:
+        opt_state = _restore_with_layout_migration(
+            ckptr, os.path.join(path, "opt_state"),
+            opt_state_template, opt_state_shardings,
+        )
+    return params, opt_state, meta
+
+
+def restore_params(
+    path: str,
+    params_template: Any,
+    param_shardings: Any | None = None,
+) -> tuple[Any, CheckpointMeta]:
+    """Params-only restore for inference (``sample.py``): skips the optimizer
+    state entirely, so loading for sampling costs 1x model memory instead of
+    the 3x a full resume restore materializes (params + AdamW m/v)."""
     with open(os.path.join(path, "meta.json")) as f:
         meta = CheckpointMeta.from_json(f.read())
     with ocp.StandardCheckpointer() as ckptr:
@@ -205,11 +230,7 @@ def restore_checkpoint(
             ckptr, os.path.join(path, "params"),
             params_template, param_shardings,
         )
-        opt_state = _restore_with_layout_migration(
-            ckptr, os.path.join(path, "opt_state"),
-            opt_state_template, opt_state_shardings,
-        )
-    return params, opt_state, meta
+    return params, meta
 
 
 def export_full_params(params: Any) -> dict[str, np.ndarray]:

@@ -1,0 +1,138 @@
+"""Sampling CLI: generate text from a trained checkpoint.
+
+Closes the train -> checkpoint -> sample loop (the reference is train-only;
+its ``load_checkpoint`` is an empty stub,
+``/root/reference/train_gpt2_distributed.py:104-111``, and it has no
+inference entry point at all). Usage::
+
+    gpt2-tpu-sample --ckpt runs/ckpt --prompt "The meaning of life" --new 64
+    gpt2-tpu-sample --ckpt runs/ckpt/step_0001000 --prompt_ids 464,3616 \
+        --temperature 0 --decode_path cached
+
+``--ckpt`` accepts either one checkpoint directory (``step_NNNNNNN``) or a
+save dir, in which case the latest checkpoint is used. Model architecture
+comes from ``--model`` + override flags exactly like ``train.py`` (the
+checkpoint stores arrays, not architecture — matching the reference's
+code-specifies-model convention, SURVEY.md §5.6).
+
+Text prompts/continuations need tiktoken's GPT-2 BPE (network-gated on
+first fetch); ``--prompt_ids`` works fully offline and prints token ids.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--ckpt", required=True,
+                   help="checkpoint dir (step_NNNNNNN) or save dir (uses latest)")
+    p.add_argument("--model", default="124M", choices=sorted(MODEL_PRESETS))
+    p.add_argument("--n_layer", type=int, default=None)
+    p.add_argument("--n_embd", type=int, default=None)
+    p.add_argument("--n_head", type=int, default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument(
+        "--seq_len", type=int, default=None,
+        help="n_positions the checkpoint was trained with, when it differs "
+        "from the preset (train.py --seq_len resizes wpe)",
+    )
+    p.add_argument("--prompt", default=None, help="text prompt (needs tiktoken BPE)")
+    p.add_argument("--prompt_ids", default=None,
+                   help="comma-separated token ids (offline alternative)")
+    p.add_argument("--new", type=int, default=64, help="tokens to generate")
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top_k", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--decode_path", default="auto", choices=["auto", "cached", "reforward"],
+        help="'cached' = KV-cache prefill+decode (wins at batch>=16 on v5e), "
+        "'reforward' = full re-forward per token; 'auto' picks reforward "
+        "because this CLI always generates batch=1, below the measured "
+        "cache-path crossover (scripts/bench_decode.py)",
+    )
+    p.add_argument("--device", default=None,
+                   help="jax platform override (cpu|tpu), like train.py --device")
+    return p
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_argparser().parse_args(argv)
+    if args.device:
+        os.environ["JAX_PLATFORMS"] = args.device
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.device:
+        jax.config.update("jax_platforms", args.device)
+
+    from gpt_2_distributed_tpu.checkpoint import latest_checkpoint, restore_params
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.models.decode import generate_cached
+    from gpt_2_distributed_tpu.models.generate import generate
+
+    overrides = {
+        k: getattr(args, k)
+        for k in ("n_layer", "n_embd", "n_head", "vocab_size")
+        if getattr(args, k) is not None
+    }
+    if args.seq_len is not None:
+        overrides["n_positions"] = args.seq_len
+    config = MODEL_PRESETS[args.model].replace(**overrides)
+
+    path = args.ckpt
+    if not os.path.exists(os.path.join(path, "meta.json")):
+        latest = latest_checkpoint(path)
+        if latest is None:
+            sys.exit(f"no checkpoint found under {path!r}")
+        path = latest
+
+    if (args.prompt is None) == (args.prompt_ids is None):
+        sys.exit("exactly one of --prompt / --prompt_ids is required")
+
+    enc = None
+    if args.prompt is not None:
+        try:
+            import tiktoken
+
+            enc = tiktoken.get_encoding("gpt2")
+        except Exception as e:  # noqa: BLE001 — network-gated BPE fetch
+            sys.exit(f"--prompt needs tiktoken's GPT-2 BPE ({e}); "
+                     "use --prompt_ids offline")
+        ids = enc.encode_ordinary(args.prompt)
+    else:
+        ids = [int(t) for t in args.prompt_ids.split(",")]
+    if not ids:
+        sys.exit("empty prompt")
+    bad = [t for t in ids if not 0 <= t < config.vocab_size]
+    if bad:
+        sys.exit(f"prompt ids out of vocab range: {bad[:5]}")
+
+    template = jax.eval_shape(lambda: gpt2.init_params(config))
+    params, meta = restore_params(path, template)
+    print(f"checkpoint: {path} (step {meta.step}, "
+          f"{meta.total_tokens:,} tokens trained)", file=sys.stderr)
+
+    prompt = jnp.asarray([ids], jnp.int32)
+    fn = generate_cached if args.decode_path == "cached" else generate
+    out = fn(
+        params, config, prompt, jax.random.PRNGKey(args.seed),
+        max_new_tokens=args.new, temperature=args.temperature,
+        top_k=args.top_k,
+    )
+    out_ids = [int(t) for t in out[0]]
+    if enc is not None:
+        print(enc.decode(out_ids))
+    else:
+        print(",".join(str(t) for t in out_ids))
+
+
+if __name__ == "__main__":
+    main()
