@@ -47,6 +47,11 @@ def main() -> None:
         "overlap, PERF_ANALYSIS.md §4 — kept for sweeps on other configs)",
     )
     p.add_argument(
+        "--loss_block_rows", type=int, default=0,
+        help="blocked-CE chunk rows (0 = preset default 1024; smaller "
+        "trades throughput for peak-HBM headroom on memory-edge configs)",
+    )
+    p.add_argument(
         "--scan_layers", default="auto", choices=["auto", "on", "off"],
         help="block stack as one lax.scan ('on') or unrolled ('off'; ~11%% "
         "faster steps — XLA schedules across layer boundaries only when "
@@ -91,6 +96,8 @@ def main() -> None:
         n_positions=max(args.seq_len, 1024), remat=remat,
         scan_layers=scan_layers,
     )
+    if args.loss_block_rows:
+        config = config.replace(loss_block_rows=args.loss_block_rows)
     if args.batch:
         micro_batch = args.batch
     elif not on_tpu:

@@ -18,6 +18,8 @@ from __future__ import annotations
 import dataclasses
 from dataclasses import dataclass
 
+from gpt_2_distributed_tpu.ops.losses import DEFAULT_BLOCK_ROWS
+
 
 @dataclass(frozen=True)
 class GPT2Config:
@@ -59,6 +61,14 @@ class GPT2Config:
     # micro-batch <= 8 where the 1.6 GB logits fit — the win is one fewer
     # logits recompute in backward at the cost of storing them).
     loss_impl: str = "blocked"
+    # Row-chunk size of the blocked CE ([rows, V] transient logits per
+    # chunk). The default (ops/losses.py DEFAULT_BLOCK_ROWS — single source
+    # of truth) is the measured v5e throughput optimum at 124M/345M
+    # (PERF_ANALYSIS.md §7 — larger chunks pipeline worse); smaller values
+    # trade a little throughput for peak-HBM headroom on memory-edge
+    # configs (each halving cuts the fp32+bf16 chunk transients roughly in
+    # half, ~75 MB at 1024 rows and GPT-2 vocab).
+    loss_block_rows: int = DEFAULT_BLOCK_ROWS
 
     def __post_init__(self) -> None:
         if self.n_embd % self.n_head != 0:
@@ -73,6 +83,10 @@ class GPT2Config:
         if self.loss_impl not in ("blocked", "dense"):
             raise ValueError(
                 f"loss_impl={self.loss_impl!r}: expected 'blocked' or 'dense'"
+            )
+        if self.loss_block_rows < 1:
+            raise ValueError(
+                f"loss_block_rows={self.loss_block_rows} must be >= 1"
             )
         if self.remat not in (False, True, "block", "mlp", "dots"):
             raise ValueError(

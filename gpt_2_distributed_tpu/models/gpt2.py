@@ -348,7 +348,8 @@ def forward(
     if labels is not None and not return_logits and config.loss_impl == "blocked":
         # Training path: blocked CE over the tied head — no [B,T,V] logits.
         loss = blocked_cross_entropy(
-            x.reshape(-1, config.n_embd), wte, labels.reshape(-1)
+            x.reshape(-1, config.n_embd), wte, labels.reshape(-1),
+            config.loss_block_rows,
         )
         return None, loss
 

@@ -117,6 +117,11 @@ def build_parser() -> argparse.ArgumentParser:
         "micro-batches; see PERF_ANALYSIS.md)",
     )
     p.add_argument(
+        "--loss_block_rows", type=int, default=0,
+        help="blocked-CE chunk rows (0 = preset default 1024; smaller "
+        "trades throughput for peak-HBM headroom)",
+    )
+    p.add_argument(
         "--scan_layers", default="auto", choices=["auto", "on", "off"],
         help="block stack as one lax.scan ('on': constant-size HLO, fast "
         "compile — needed for 774M/1.5B) or unrolled ('off': ~11%% faster "
@@ -232,6 +237,8 @@ def main(argv: list[str] | None = None) -> None:
     )
     if args.attention_impl:
         config = config.replace(attention_impl=args.attention_impl)
+    if args.loss_block_rows:
+        config = config.replace(loss_block_rows=args.loss_block_rows)
 
     # --- mesh ---------------------------------------------------------------
     spec = MeshSpec.parse(args.mesh) if args.mesh else MeshSpec.for_mode(args.training_mode)

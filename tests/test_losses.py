@@ -146,6 +146,29 @@ def test_loss_impl_dense_config_path(tiny_config, rng_np):
     np.testing.assert_allclose(float(loss_dense), float(loss_blocked), rtol=1e-6)
 
 
+def test_config_loss_block_rows_threads_through(tiny_config, rng_np):
+    """config.loss_block_rows reaches the blocked CE: loss identical across
+    chunkings (fp32), and the value is validated."""
+    from gpt_2_distributed_tpu.config import GPT2Config
+    from gpt_2_distributed_tpu.models import gpt2
+
+    params = gpt2.init_params(tiny_config)
+    x = jnp.asarray(rng_np.integers(0, tiny_config.vocab_size, (2, 33)), jnp.int32)
+    y = jnp.asarray(rng_np.integers(0, tiny_config.vocab_size, (2, 33)), jnp.int32)
+    losses = [
+        float(gpt2.forward(
+            params, tiny_config.replace(loss_block_rows=br), x, labels=y,
+            compute_dtype=jnp.float32,
+        )[1])
+        for br in (7, 32, 1024)
+    ]
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    np.testing.assert_allclose(losses[0], losses[2], rtol=1e-6)
+
+    with pytest.raises(ValueError, match="loss_block_rows"):
+        GPT2Config(loss_block_rows=0)
+
+
 def test_config_validates_impl_choices():
     import pytest
 
