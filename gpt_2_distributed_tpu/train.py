@@ -28,6 +28,7 @@ from typing import Any
 import numpy as np
 
 from gpt_2_distributed_tpu.config import MODEL_PRESETS
+from gpt_2_distributed_tpu.ops.losses import DEFAULT_BLOCK_ROWS
 from gpt_2_distributed_tpu.data.dataloader import (
     DEFAULT_BATCH_SIZE,
     DEFAULT_CONTEXT_LENGTH,
@@ -118,8 +119,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--loss_block_rows", type=int, default=0,
-        help="blocked-CE chunk rows (0 = preset default 1024; smaller "
-        "trades throughput for peak-HBM headroom)",
+        help="blocked-CE chunk rows (0 = preset default "
+        f"{DEFAULT_BLOCK_ROWS}; smaller trades throughput for peak-HBM "
+        "headroom)",
     )
     p.add_argument(
         "--scan_layers", default="auto", choices=["auto", "on", "off"],

@@ -1,0 +1,45 @@
+"""The driver contract (__graft_entry__.py) must stay green: entry() is the
+single-chip compile check, dryrun_multichip(n) the virtual-mesh sharded-step
+check. Both run in subprocesses because dryrun_multichip re-initializes the
+JAX backend (clear_backends + jax_num_cpu_devices), which must not leak into
+this process's fixtures."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code: str, extra_env: dict | None = None, timeout: int = 600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_entry_compiles_and_returns_finite_loss():
+    r = _run(
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "logits, loss = jax.jit(fn)(*args)\n"
+        "assert float(loss) > 0 and float(loss) == float(loss), loss\n"
+        "print('ENTRY_OK', float(loss))\n"
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ENTRY_OK" in r.stdout
+
+
+def test_dryrun_multichip_8_devices():
+    r = _run(
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"  # raises on any compile/run failure
+        "print('DRYRUN_OK')\n",
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DRYRUN_OK" in r.stdout
