@@ -46,13 +46,14 @@ def main() -> None:
         "(measured WORSE at 124M — memory pressure beats the cross-micro "
         "overlap, PERF_ANALYSIS.md §4 — kept for sweeps on other configs)",
     )
-    from gpt_2_distributed_tpu.ops.losses import DEFAULT_BLOCK_ROWS
-
     p.add_argument(
         "--loss_block_rows", type=int, default=0,
-        help=f"blocked-CE chunk rows (0 = preset default {DEFAULT_BLOCK_ROWS}; "
-        "smaller trades throughput for peak-HBM headroom on memory-edge "
-        "configs)",
+        # "1024" is DEFAULT_BLOCK_ROWS; kept literal because importing
+        # ops.losses here would drag the jax import into --help (bench.py
+        # defers all jax-touching imports until after parse_args).
+        # tests/test_losses.py pins the two in sync.
+        help="blocked-CE chunk rows (0 = preset default 1024; smaller "
+        "trades throughput for peak-HBM headroom on memory-edge configs)",
     )
     p.add_argument(
         "--scan_layers", default="auto", choices=["auto", "on", "off"],
