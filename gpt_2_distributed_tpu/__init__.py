@@ -9,6 +9,6 @@ reference obtains from NCCL via torch DDP/FSDP wrappers).
 
 from gpt_2_distributed_tpu.config import GPT2Config, MODEL_PRESETS
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"  # kept in lockstep with pyproject.toml (tests/test_config.py pins it)
 
 __all__ = ["GPT2Config", "MODEL_PRESETS", "__version__"]

@@ -60,6 +60,12 @@ class GPT2Config:
     # [B*T, V] fp32 logits and let XLA autodiff (measured slightly faster at
     # micro-batch <= 8 where the 1.6 GB logits fit — the win is one fewer
     # logits recompute in backward at the cost of storing them).
+    # bf16 numerics differ between the two by design: "blocked" emits bf16
+    # chunk logits (torch-autocast's own lm_head dtype — the parity choice,
+    # and the change that crossed the 50%-MFU line, PERF_ANALYSIS.md §7)
+    # while "dense" keeps fp32-accumulated logits, so bf16 losses agree only
+    # to ~2e-3 (pinned in tests/test_losses.py). fp32 inputs are
+    # bit-identical on both paths.
     loss_impl: str = "blocked"
     # Row-chunk size of the blocked CE ([rows, V] transient logits per
     # chunk). The default (ops/losses.py DEFAULT_BLOCK_ROWS — single source

@@ -95,10 +95,15 @@ def collect_performance(tracker: "StatsTracker") -> dict[str, float]:
 
 
 for _name, _red, _fmt in (
-    # The reference declares tokens_per_second with ReductionStrategy.SUM but
-    # its cross-rank reduce is always a mean (SURVEY.md C21); here the window
-    # reduction is what SUM governs, and the tracker multiplies the
-    # cross-process mean by process_count to report true system throughput.
+    # tokens_per_second is a collector metric: it never crosses processes.
+    # It reports true GLOBAL system throughput because the driver constructs
+    # the tracker with the global effective batch (micro-batch x grad_accum x
+    # data-parallel degree — train.py StatsTracker(batch_size=global_batch)),
+    # so tokens_per_step already counts every process's tokens. The reference
+    # instead declares SUM but mean-reduces across ranks (SURVEY.md C21),
+    # publishing mean per-worker throughput under a "total system" docstring;
+    # this build fixes that without per-step host synchronization. Pinned by
+    # tests/test_multihost.py::test_tokens_per_second_is_global_not_per_host.
     ("tokens_per_second", ReductionStrategy.CURRENT, "tok/s: {value:,.0f}"),
     ("total_tokens", ReductionStrategy.CURRENT, "total_tok: {value:,.0f}"),
     ("epoch_time", ReductionStrategy.CURRENT, "epoch_s: {value:.1f}"),

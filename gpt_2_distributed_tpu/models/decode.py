@@ -61,7 +61,11 @@ def _prefill(
     positions [0, P).
 
     Mirrors ``gpt2.hidden_states`` (same sublayer math, deterministic) but
-    captures each layer's K/V projection instead of discarding it.
+    captures each layer's K/V projection instead of discarding it. The
+    attention sublayer below is an inline copy of ``gpt2._attn_sublayer``
+    (which cannot return K/V without widening its training-path signature);
+    any structural change there must land here too — the teacher-forcing
+    parity test in tests/test_decode.py enforces the mirror.
     """
     b, p = prompt.shape
     h, d = config.n_head, config.head_dim

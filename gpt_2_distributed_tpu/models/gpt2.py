@@ -169,7 +169,14 @@ def _attn_sublayer(
     rng: jax.Array | None,
     deterministic: bool,
 ) -> jnp.ndarray:
-    """x + dropout(proj(attn(ln1(x))))."""
+    """x + dropout(proj(attn(ln1(x)))).
+
+    NOTE: ``models/decode.py::_prefill`` mirrors this sublayer inline (it
+    must capture each layer's K/V projection, which this function discards).
+    A change to the sublayer structure here — a new op, a moved dropout
+    site — must be replicated there; the teacher-forcing logit-parity test
+    in tests/test_decode.py is the guard that catches a desync.
+    """
     b, t, c = x.shape
     cdt = x.dtype
     if rng is not None:

@@ -60,7 +60,20 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30  # causal mask fill for fp32 row-max stability (see docstring)
+LOG2E = 1.4426950408889634  # exp(x) == exp2(x * LOG2E); folded into the q scale
 DEFAULT_BLOCK_Q = 512  # fastest on v5e at seq 1024 (256/512/1024 swept)
+
+
+def default_blocks(t: int) -> tuple[int, int]:
+    """T-aware (block_q, block_k) default, from the round-4 on-chip sweep.
+
+    The kernel's non-MXU cost is ~1 us per grid step (measured constant
+    across T), so long sequences want the largest blocks VMEM admits:
+    1024x1024 measured 47/72 TF/s fwd (T=4096/2048) and 50/54 TF/s fwd+bwd
+    vs ~25-30 for 512x512. Short sequences keep 512x512 — with T/block ~ 2
+    the bigger blocks just trade causal skipping for wasted masked compute
+    (a 1024-block at T=1024 computes the full upper triangle)."""
+    return (512, 512) if t < 2048 else (1024, 1024)
 
 # ---------------------------------------------------------------------------
 # SPMD: Mosaic custom calls cannot be auto-partitioned by GSPMD — jitting this
@@ -117,19 +130,40 @@ def pick_block_q(t: int, preferred: int = DEFAULT_BLOCK_Q) -> int | None:
 
 
 def _dropout_bits(seed, b, h, row_off, col_off, shape):
-    """Counter-based uint32 random bits for one [rows, cols] tile: 2-D iotas
-    over the shared ``spmd.dropout_hash_bits`` stream — the backward kernel
+    """Counter-based uint32 random bits for one [rows, cols] tile over the
+    shared ``spmd.dropout_hash_bits`` stream — the backward kernel
     regenerates the forward's exact mask by construction, and the same bits
-    come out on TPU and in CPU interpret mode."""
+    come out on TPU and in CPU interpret mode.
+
+    The iotas are [rows, 1] and [1, cols] (not full tiles): the hash's
+    coordinate mixing is an XOR of per-dim products, so broadcasting defers
+    every pre-finalizer op to vector width — only the murmur finalizer runs
+    at tile width. Same bits, ~half the VPU passes (the dropout hash was
+    costing as much as the whole softmax chain at seq 2048)."""
     b = jnp.asarray(b).astype(jnp.uint32)
     h = jnp.asarray(h).astype(jnp.uint32)
     row = jnp.asarray(row_off).astype(jnp.uint32) + jax.lax.broadcasted_iota(
-        jnp.uint32, shape, 0
+        jnp.uint32, (shape[0], 1), 0
     )
     col = jnp.asarray(col_off).astype(jnp.uint32) + jax.lax.broadcasted_iota(
-        jnp.uint32, shape, 1
+        jnp.uint32, (1, shape[1]), 1
     )
     return dropout_hash_bits(seed, b, h, row, col)
+
+
+def _causal_gates(qi, j, bq, bk):
+    """(needed, fully_unmasked, is_last) for a [bq, bk] block at grid step
+    (qi, j) of a causal schedule with independent q/k block sizes.
+
+    needed: the block intersects the causal (lower-triangular) region.
+    fully_unmasked: every (row, col) in the block satisfies col <= row, so
+    the triangular mask (2 iotas + compare + select VPU passes) can be
+    skipped.  is_last: j is the final needed k-block for this q-block — the
+    online accumulators are complete and outputs can be written."""
+    needed = j * bk < (qi + 1) * bq
+    fully_unmasked = (j + 1) * bk - 1 <= qi * bq
+    last_j = ((qi + 1) * bq + bk - 1) // bk - 1
+    return needed, fully_unmasked, j == last_j
 
 
 def _fwd_kernel(
@@ -138,19 +172,25 @@ def _fwd_kernel(
     k_ref,     # [1, 1, bk, D]
     v_ref,     # [1, 1, bk, D]
     o_ref,     # [1, 1, bq, D]
-    lse_ref,   # [1, 1, bq, 1] f32
+    lse_ref,   # [1, 1, bq, 1] f32, base-2 (m2 + log2 l) — internal to the VJP
     m_scr,     # VMEM scratch [bq, 1] f32
     l_scr,     # VMEM scratch [bq, 1] f32
     acc_scr,   # VMEM scratch [bq, D] f32
     *,
     block_q: int,
+    block_k: int,
     dropout_rate: float,
 ):
     b, h, qi, j = (pl.program_id(0), pl.program_id(1),
                    pl.program_id(2), pl.program_id(3))
-    bq = block_q
+    bq, bk = block_q, block_k
     d = q_ref.shape[3]
-    scale = 1.0 / (d ** 0.5)
+    # 1/sqrt(d) * log2(e): scale folded into q ([bq, D]) instead of s
+    # ([bq, bk]) — one fewer full-stripe VPU pass — and the log2(e) folding
+    # turns every exp into a native exp2 (softmax runs in base 2; l is still
+    # the exact linear-domain row sum because exp2((s - m) * log2e) == exp(s - m)).
+    scale = LOG2E / (d ** 0.5)
+    needed, unmasked, is_last = _causal_gates(qi, j, bq, bk)
 
     @pl.when(j == 0)
     def _init():
@@ -159,28 +199,26 @@ def _fwd_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     def _compute(masked: bool):
-        # The 1/sqrt(d) scale is folded into q ([bq, D]) instead of s
-        # ([bq, bk]) — one fewer full-stripe VPU pass.
         q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
         k = k_ref[0, 0]                               # [bk, D] bf16
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                             # [bq, bk] f32
+        )                                             # [bq, bk] f32, base-2 logits
         if masked:
-            # Only the diagonal block pays the triangular mask; off-diagonal
-            # blocks (j < qi) are fully unmasked and skip these VPU passes.
-            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            # Only diagonal-crossing blocks pay the triangular mask;
+            # fully-below-diagonal blocks skip these VPU passes.
+            row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(col <= row, s, NEG_INF)
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                        # [bq, bk] f32
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(s - m_new)                       # [bq, bk] f32
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
-            bits = _dropout_bits(seed_ref[0], b, h, qi * bq, j * bq, s.shape)
+            bits = _dropout_bits(seed_ref[0], b, h, qi * bq, j * bk, s.shape)
             threshold = jnp.uint32(int(dropout_rate * (2**32)))
             p = jnp.where(bits >= threshold, p / (1.0 - dropout_rate), 0.0)
         v = v_ref[0, 0]                               # [bk, D] bf16
@@ -189,13 +227,13 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    pl.when(j < qi)(lambda: _compute(masked=False))
-    pl.when(j == qi)(lambda: _compute(masked=True))
+    pl.when(needed & unmasked)(lambda: _compute(masked=False))
+    pl.when(needed & jnp.logical_not(unmasked))(lambda: _compute(masked=True))
 
-    @pl.when(j == qi)
+    @pl.when(is_last)
     def _finalize():
         l = l_scr[...]
-        lse_ref[0, 0] = m_scr[...] + jnp.log(l)
+        lse_ref[0, 0] = m_scr[...] + jnp.log2(l)
         o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
@@ -213,14 +251,23 @@ def _bwd_kernel(
     dq_scr,     # VMEM scratch [bq, D] f32
     *,
     block_q: int,
+    block_k: int,
     dropout_rate: float,
 ):
     b, h, qi, j = (pl.program_id(0), pl.program_id(1),
                    pl.program_id(2), pl.program_id(3))
-    bq = block_q
+    bq, bk = block_q, block_k
     d = q_ref.shape[3]
-    scale = 1.0 / (d ** 0.5)
+    # Base-2 folding as in the fwd kernel: s here is scale*log2e*q @ k^T and
+    # the saved lse is base-2, so p = exp2(s - lse) is the exact normalized
+    # probability. The chain rule in natural domain needs dq = c*(ds @ k) and
+    # dk = c*(ds^T @ q) with c = 1/sqrt(d); contracting against the
+    # log2e-scaled q makes the dk contraction come out *log2e too big, so the
+    # correction lands as cheap [*, D]-tile post-multiplies, never on the
+    # [bq, bk] stripe.
+    scale = LOG2E / (d ** 0.5)
     kp = 1.0 - dropout_rate
+    needed, unmasked, is_last = _causal_gates(qi, j, bq, bk)
 
     @pl.when((qi == 0) & (j == 0))
     def _init_kv():
@@ -232,30 +279,27 @@ def _bwd_kernel(
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     def _compute(masked: bool):
-        # Scale folded into q (see fwd kernel); the same scaled-q feeds the
-        # s recompute AND the dk contraction, whose extra *scale cancels the
-        # chain rule's — dk = scale * ds^T @ q = ds^T @ (scale * q).
         q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
         k = k_ref[0, 0]                               # [bk, D] bf16
         v = v_ref[0, 0]                               # [bk, D] bf16
         do = do_ref[0, 0]                             # [bq, D] bf16
-        lse = lse_ref[0, 0]                           # [bq, 1] f32
+        lse = lse_ref[0, 0]                           # [bq, 1] f32, base-2
         delta = delta_ref[0, 0]                       # [bq, 1] f32
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                             # [bq, bk] f32
+        )                                             # [bq, bk] f32, base-2
         if masked:
-            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(col <= row, s, NEG_INF)
-        p = jnp.exp(s - lse)                          # normalized probs
+        p = jnp.exp2(s - lse)                         # normalized probs
         dpd = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                             # dL/d(dropped P)
         if dropout_rate > 0.0:
-            bits = _dropout_bits(seed_ref[0], b, h, qi * bq, j * bq, s.shape)
+            bits = _dropout_bits(seed_ref[0], b, h, qi * bq, j * bk, s.shape)
             keep = bits >= jnp.uint32(int(dropout_rate * (2**32)))
             pd = jnp.where(keep, p / kp, 0.0)         # dropped+rescaled probs
             dp = jnp.where(keep, dpd / kp, 0.0)       # dL/dP
@@ -263,30 +307,41 @@ def _bwd_kernel(
             pd = p
             dp = dpd
 
-        ds = (p * (dp - delta)).astype(q.dtype)       # [bq, bk] bf16
+        ds = (p * (dp - delta)).astype(q.dtype)       # [bq, bk] bf16 (natural ds)
         dq_scr[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
-        dk_ref[0, 0, pl.ds(j * bq, bq), :] += jax.lax.dot_general(
+        ) * (scale / LOG2E)
+        dk_ref[0, 0, pl.ds(j * bk, bk), :] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                             # [bk, D] (scale in q)
-        dv_ref[0, 0, pl.ds(j * bq, bq), :] += jax.lax.dot_general(
+        ) * (1.0 / LOG2E)                             # [bk, D] (scale*log2e in q)
+        dv_ref[0, 0, pl.ds(j * bk, bk), :] += jax.lax.dot_general(
             pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                             # [bk, D]
 
-    pl.when(j < qi)(lambda: _compute(masked=False))
-    pl.when(j == qi)(lambda: _compute(masked=True))
+    pl.when(needed & unmasked)(lambda: _compute(masked=False))
+    pl.when(needed & jnp.logical_not(unmasked))(lambda: _compute(masked=True))
 
-    @pl.when(j == qi)
+    @pl.when(is_last)
     def _finalize():
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
+# Forward grid order is (b, h, qi) parallel, k-block "arbitrary" (the
+# online-softmax accumulators are carried across the innermost dimension).
+# Declaring the outer three parallel lets Mosaic relax cross-step ordering.
+# The BACKWARD must keep qi "arbitrary": its dk/dv output blocks are
+# revisited accumulators spanning every (qi, j) step of one (b, h) — a
+# parallel qi licenses Mosaic to flush/refetch them per q-block, which
+# measured 3x slower at seq 4096.
+_FWD_DIM_SEMANTICS = ("parallel", "parallel", "parallel", "arbitrary")
+_BWD_DIM_SEMANTICS = ("parallel", "parallel", "arbitrary", "arbitrary")
+
+
 @functools.lru_cache(maxsize=None)
-def _build(dropout_rate: float, block_q: int, interpret: bool):
+def _build(dropout_rate: float, block_q: int, block_k: int, interpret: bool):
     """Build the custom-VJP flash attention ([B, H, T, D]) for one config.
 
     Device-local: callers shard over (batch, head) with ``jax.shard_map``
@@ -295,15 +350,16 @@ def _build(dropout_rate: float, block_q: int, interpret: bool):
     def _raw_fwd(seed, q, k, v):
         batch, heads, t, d = q.shape
         nq = t // block_q
+        nk = t // block_k
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(batch, heads, nq, nq),
+            grid=(batch, heads, nq, nk),
             in_specs=[
                 pl.BlockSpec((1, 1, block_q, d),
                              lambda b, h, i, j, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, d),
+                pl.BlockSpec((1, 1, block_k, d),
                              lambda b, h, i, j, *_: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_q, d),
+                pl.BlockSpec((1, 1, block_k, d),
                              lambda b, h, i, j, *_: (b, h, j, 0)),
             ],
             out_specs=[
@@ -320,13 +376,17 @@ def _build(dropout_rate: float, block_q: int, interpret: bool):
         )
         o, lse = pl.pallas_call(
             functools.partial(
-                _fwd_kernel, block_q=block_q, dropout_rate=dropout_rate
+                _fwd_kernel, block_q=block_q, block_k=block_k,
+                dropout_rate=dropout_rate,
             ),
             grid_spec=grid_spec,
             out_shape=[
                 jax.ShapeDtypeStruct(q.shape, q.dtype),
                 jax.ShapeDtypeStruct((batch, heads, t, 1), jnp.float32),
             ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=_FWD_DIM_SEMANTICS,
+            ),
             interpret=interpret,
         )(seed, q, k, v)
         return o, lse
@@ -343,15 +403,16 @@ def _build(dropout_rate: float, block_q: int, interpret: bool):
     def _raw_bwd(seed, q, k, v, do, lse, delta):
         batch, heads, t, d = q.shape
         nq = t // block_q
+        nk = t // block_k
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(batch, heads, nq, nq),
+            grid=(batch, heads, nq, nk),
             in_specs=[
                 pl.BlockSpec((1, 1, block_q, d),
                              lambda b, h, i, j, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, d),
+                pl.BlockSpec((1, 1, block_k, d),
                              lambda b, h, i, j, *_: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_q, d),
+                pl.BlockSpec((1, 1, block_k, d),
                              lambda b, h, i, j, *_: (b, h, j, 0)),
                 pl.BlockSpec((1, 1, block_q, d),
                              lambda b, h, i, j, *_: (b, h, i, 0)),
@@ -374,7 +435,8 @@ def _build(dropout_rate: float, block_q: int, interpret: bool):
         )
         dq, dk, dv = pl.pallas_call(
             functools.partial(
-                _bwd_kernel, block_q=block_q, dropout_rate=dropout_rate
+                _bwd_kernel, block_q=block_q, block_k=block_k,
+                dropout_rate=dropout_rate,
             ),
             grid_spec=grid_spec,
             out_shape=[
@@ -382,6 +444,14 @@ def _build(dropout_rate: float, block_q: int, interpret: bool):
                 jax.ShapeDtypeStruct(k.shape, jnp.float32),
                 jax.ShapeDtypeStruct(v.shape, jnp.float32),
             ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=_BWD_DIM_SEMANTICS,
+                # The revisited dk/dv accumulators ([T, D] f32 x2) plus
+                # [bq, bk] stripe temps exceed the 16M default scoped-vmem
+                # limit at block 1024x1024 / seq 4096; the physical VMEM is
+                # far larger and the raised cap measured fastest.
+                vmem_limit_bytes=64 * 1024 * 1024,
+            ),
             interpret=interpret,
         )(seed, q, k, v, do, lse, delta)
         return dq, dk, dv
@@ -407,21 +477,27 @@ def flash_attention(
     dropout_rate: float = 0.0,
     rng: jax.Array | None = None,
     deterministic: bool = True,
-    block_q: int = DEFAULT_BLOCK_Q,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Causal flash attention, drop-in for ``ops.attention.causal_attention``.
 
     Requires ``T % block_q == 0`` (the driver picks block_q <= T). ``rng``
-    seeds the in-kernel dropout hash when training.
+    seeds the in-kernel dropout hash when training. ``block_q``/``block_k``
+    default per sequence length (``default_blocks`` — the round-4 on-chip
+    sweep: big blocks amortize the ~1 us/grid-step Mosaic overhead that
+    dominates this kernel at D=64, at the price of coarser causal skipping).
     """
     t = q.shape[2]
-    block_q = pick_block_q(t, block_q)
+    dq, dk_ = default_blocks(t)
+    block_q = pick_block_q(t, block_q if block_q is not None else dq)
     if block_q is None:
         raise ValueError(
             f"flash attention needs T divisible by a viable block size "
             f"(512/256/128), got T={t}"
         )
+    block_k = pick_block_q(t, block_k if block_k is not None else dk_)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     rate = float(dropout_rate) if (not deterministic and rng is not None) else 0.0
@@ -430,7 +506,7 @@ def flash_attention(
         seed = jax.random.randint(rng, (1,), 0, jnp.iinfo(jnp.int32).max, jnp.int32)
     else:
         seed = jnp.zeros((1,), jnp.int32)
-    attn = _build(rate, block_q, interpret)
+    attn = _build(rate, block_q, block_k, interpret)
 
     mesh = _ambient_mesh()
     if mesh is not None:

@@ -116,7 +116,13 @@ def main(argv: list[str] | None = None) -> None:
         sys.exit(f"prompt ids out of vocab range: {bad[:5]}")
 
     template = jax.eval_shape(lambda: gpt2.init_params(config))
-    params, meta = restore_params(path, template)
+    # Explicit single-device shardings: without them orbax re-applies the
+    # shardings recorded in the checkpoint files — exactly the path it warns
+    # is unsafe when restoring on a different topology, and sampling a
+    # pod-trained checkpoint on one host/chip IS that case (round-3 ADVICE).
+    one_device = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    shardings = jax.tree_util.tree_map(lambda _: one_device, template)
+    params, meta = restore_params(path, template, shardings)
     print(f"checkpoint: {path} (step {meta.step}, "
           f"{meta.total_tokens:,} tokens trained)", file=sys.stderr)
 

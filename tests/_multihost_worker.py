@@ -172,6 +172,40 @@ def main() -> None:
             params, opt_state, m1 = step(params, opt_state, xs, ys, key, 1)
             record["loss1"] = float(m1.loss)
 
+    elif phase == "tracker":
+        # Round-3 VERDICT weak-point #5: pin the throughput CONTRACT under
+        # real multi-process conditions. tokens_per_second is a collector
+        # metric that never crosses processes; it is global-correct because
+        # every process constructs the tracker with the GLOBAL effective
+        # batch (train.py passes global_batch). Assert the collected value
+        # is global tokens / dt — not per-host (half), not double-counted —
+        # and that MFU derives from the per-chip rate.
+        import time
+
+        from gpt_2_distributed_tpu.metrics.builtin import collect_performance
+        from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
+
+        global_batch, seq_len = 16, 32
+        tracker = StatsTracker(
+            tb_dir=None,
+            batch_size=global_batch,       # GLOBAL, same value on every rank
+            seq_len=seq_len,
+            cli_every=10_000,              # keep the token window un-reset
+            flops_per_token=100.0,
+            peak_flops_per_chip=1000.0,
+            print_fn=lambda _s: None,
+        )
+        record["n_chips"] = tracker.n_chips  # 8 global devices, not 4 local
+        tracker.update(1, loss=1.0)
+        tracker.update(2, loss=1.0)        # window now holds 2 global steps
+        # Freeze the window to exactly 2 s and pull the perf collector.
+        tracker.window_start_time = time.perf_counter() - 2.0
+        out = collect_performance(tracker)
+        record["tokens_per_second"] = out["tokens_per_second"]
+        record["tokens_per_second_per_chip"] = out["tokens_per_second_per_chip"]
+        record["mfu"] = out["mfu"]
+        record["expected_tok_s"] = 2 * global_batch * seq_len / 2.0
+
     else:
         raise SystemExit(f"unknown phase {phase!r}")
 

@@ -11,7 +11,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(code: str, drop_device_count_flag: bool = False, timeout: int = 600):
+def _run(code: str, drop_device_count_flag: bool = False, timeout: int = 1500):
+    # dryrun_multichip now also shards the REAL 774M/1.5B pytrees (round-4;
+    # ~1.5 min each on this 1-core host) — the timeout covers toy step +
+    # both preset sharding proofs with margin.
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     if drop_device_count_flag:

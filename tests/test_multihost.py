@@ -137,6 +137,32 @@ def test_tracker_reduce_is_cross_process_mean(worker_results):
     assert r0["reduced_const"] == pytest.approx(7.0)
 
 
+def test_tokens_per_second_is_global_not_per_host():
+    """Round-3 VERDICT weak-point #5: the throughput contract, pinned under
+    2 real processes. ``tokens_per_second`` must equal global_batch x seq / dt
+    — not the per-host rate (half), not a double-counted cross-process sum —
+    and MFU must derive from the per-chip rate over GLOBAL device count."""
+    r0, r1 = _run_worker_pair("tracker")
+    for r in (r0, r1):
+        # n_chips is the global device count (8), not the 4 local devices.
+        assert r["n_chips"] == 8
+        # 2 steps x 16 global batch x 32 seq over a 2 s window = 512 tok/s.
+        assert r["expected_tok_s"] == 512.0
+        assert r["tokens_per_second"] == pytest.approx(512.0, rel=1e-2)
+        assert r["tokens_per_second_per_chip"] == pytest.approx(
+            r["tokens_per_second"] / 8, rel=1e-9
+        )
+        # mfu = tok/s/chip * flops_per_token / peak_flops_per_chip
+        assert r["mfu"] == pytest.approx(
+            r["tokens_per_second_per_chip"] * 100.0 / 1000.0, rel=1e-9
+        )
+    # The collector never crosses processes: both ranks compute the same
+    # global value independently.
+    assert r0["tokens_per_second"] == pytest.approx(
+        r1["tokens_per_second"], rel=1e-2
+    )
+
+
 def test_multiprocess_checkpoint_save_restore(tmp_path_factory):
     """Round-2 VERDICT next-step #3: sharded orbax save with ALL processes in
     the collective, then a REAL restart (fresh process pair) that restores
