@@ -20,12 +20,13 @@ schedule, cf. PAPERS.md ring-attention entry):
   masked blocks contribute nothing and cost one gated matmul).
 
 Per-device memory is O(T/sp · T/sp) for one score block — long sequences
-scale by adding ring ranks. Per-block math runs on the MXU via XLA einsums
-(bf16 operands, fp32 accumulation), matching the dense/flash numerics; the
-Pallas flash kernel is not reused inside the ring because the recurrence
-needs raw (m, l, acc) carries across ring steps, which the fused kernel
-does not expose — fusing the two is a further optimization, not a
-correctness need.
+scale by adding ring ranks. Per-block math has two paths (round 4): the
+default runs the Pallas ``flash_block`` kernel per ring step (VMEM-resident
+score stripes, exp2 softmax — flash-class throughput) and recombines steps
+at BLOCK granularity from the kernel's (o, lse) outputs
+(``_ring_local_flash``); shapes too small for the kernel's 128-lane tiling
+fall back to XLA einsums with the blockwise KV sub-schedule below — both
+paths share one dropout stream and match the dense numerics.
 
 Differentiation is plain autodiff: the whole ring (scan + ppermute) is
 reverse-differentiable, with dropout applied through the same
@@ -79,6 +80,17 @@ def _dropout_bits_4d(seed, b_off, h_off, row_off, col_off, shape):
     return dropout_hash_bits(seed, b, h, row, col)
 
 
+def _shard_offset(axes, local_dim):
+    """Global element origin of this shard along sharded mesh axes — feeds
+    the dropout hash's absolute coordinates; shared by both ring paths so
+    they cannot drift off the one-stream contract."""
+    off = jnp.uint32(0)
+    for a in axes:
+        off = off * jnp.uint32(jax.lax.axis_size(a)) + jax.lax.axis_index(
+            a).astype(jnp.uint32)
+    return off * jnp.uint32(local_dim)
+
+
 def _ring_local(
     q,  # [b, tl, h, d] local Q block (model-native layout)
     k,  # [b, tl, h, d]
@@ -90,23 +102,24 @@ def _ring_local(
     b_shard_axes: tuple[str, ...],
     h_shard_axes: tuple[str, ...],
     dropout_rate: float,
+    use_flash: bool = False,
 ):
     """Device-local ring schedule; runs inside shard_map with axis ``axis``."""
+    if use_flash:
+        return _ring_local_flash(
+            q, k, v, seed, axis=axis, sp=sp,
+            b_shard_axes=b_shard_axes, h_shard_axes=h_shard_axes,
+            dropout_rate=dropout_rate,
+        )
+
     b, tl, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
     idx = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     # Global origins of this shard's batch/head dims, for the dropout hash.
-    def shard_offset(axes, local_dim):
-        off = jnp.uint32(0)
-        for a in axes:
-            off = off * jnp.uint32(jax.lax.axis_size(a)) + jax.lax.axis_index(
-                a).astype(jnp.uint32)
-        return off * jnp.uint32(local_dim)
-
-    b_off = shard_offset(b_shard_axes, b)
-    h_off = shard_offset(h_shard_axes, h)
+    b_off = _shard_offset(b_shard_axes, b)
+    h_off = _shard_offset(h_shard_axes, h)
     kp = 1.0 - dropout_rate
 
     # Blockwise attention inside the ring: per-device sequence blocks can
@@ -195,6 +208,79 @@ def _ring_local(
     return (acc / l.transpose(0, 2, 1, 3)).astype(q.dtype)
 
 
+def _ring_local_flash(
+    q,  # [b, tl, h, d] local Q block (model-native layout)
+    k,
+    v,
+    seed,  # [1] int32
+    *,
+    axis: str,
+    sp: int,
+    b_shard_axes: tuple[str, ...],
+    h_shard_axes: tuple[str, ...],
+    dropout_rate: float,
+):
+    """Flash-class ring schedule (round-3 VERDICT item 4): each ring step
+    runs the Pallas ``flash_block`` kernel on (q_local, K/V block) at global
+    coordinates and the steps recombine at BLOCK granularity via their lse
+    outputs — O(tl) XLA work per step instead of the O(tl x kv_block) einsum
+    softmax of the fallback path, with all O(tl^2) score math fused in VMEM.
+
+    Differentiation stays plain autodiff: flash_block's custom VJP accepts
+    (do, dlse) cotangents, and the lse-weighted combine is ordinary XLA, so
+    the scan + ppermute reverse-differentiates as before. The dropout stream
+    is bit-identical to the XLA path (global-coordinate hash, same seed, no
+    shard mixing), so masks remain invariant to the sp degree AND to which
+    path computed them.
+    """
+    from gpt_2_distributed_tpu.ops.flash_block import flash_block
+
+    b, tl, h, d = q.shape
+    idx = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    b_off = _shard_offset(b_shard_axes, b).astype(jnp.int32)
+    h_off = _shard_offset(h_shard_axes, h).astype(jnp.int32)
+
+    # Head-major layout for the kernel; one transpose at each boundary (XLA
+    # folds them into the surrounding reshapes).
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+
+    def fb(k_blk, v_blk, src):
+        return flash_block(
+            qh, k_blk, v_blk, idx * tl, src * tl,
+            seed=seed, b_off=b_off, h_off=h_off,
+            dropout_rate=dropout_rate,
+        )
+
+    # Own (diagonal) block first — every row's diagonal is unmasked, so lse0
+    # is finite everywhere and the combine never divides by zero.
+    o0, lse0 = fb(kh, vh, idx)
+    acc0 = o0.astype(jnp.float32)
+    l0 = jnp.ones_like(lse0)
+
+    def body(carry, r):
+        k_c, v_c, m, l, acc = carry
+        k_c = jax.lax.ppermute(k_c, axis, perm)
+        v_c = jax.lax.ppermute(v_c, axis, perm)
+        o_r, lse_r = fb(k_c, v_c, (idx - r) % sp)
+        # Block-granularity online-softmax combine: weights exp2(lse - m);
+        # fully-masked blocks return lse = NEG_INF -> weight underflows to 0.
+        m_new = jnp.maximum(m, lse_r)
+        w_old = jnp.exp2(m - m_new)
+        w_new = jnp.exp2(lse_r - m_new)
+        l = l * w_old + w_new
+        acc = acc * w_old + o_r.astype(jnp.float32) * w_new
+        return (k_c, v_c, m_new, l, acc), None
+
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        body, (kh, vh, lse0, l0, acc0), jnp.arange(1, sp)
+    )
+    return (acc / l).astype(q.dtype).transpose(0, 2, 1, 3)
+
+
 def ring_attention_bthd(
     q: jnp.ndarray,  # [B, T, H, D] (model-native layout)
     k: jnp.ndarray,
@@ -205,6 +291,7 @@ def ring_attention_bthd(
     dropout_rate: float = 0.0,
     rng: jax.Array | None = None,
     deterministic: bool = True,
+    use_flash: bool | None = None,
 ) -> jnp.ndarray:
     """Causal ring attention over mesh axis ``axis``; drop-in for
     ``causal_attention_bthd`` when the sequence dim is sharded.
@@ -212,6 +299,10 @@ def ring_attention_bthd(
     ``T`` must divide by the axis size. Batch/head dims are additionally
     split over whatever data-like/tensor-like mesh axes divide them (same
     policy as the flash kernel's shard_map wrapper).
+
+    ``use_flash`` selects the Pallas flash_block ring (``_ring_local_flash``)
+    vs the XLA einsum ring; None = auto (flash whenever the per-device block
+    T/sp divides a viable kernel block size — tiny test shapes fall back).
     """
     B, T, H, D = q.shape
     sp = mesh.shape[axis]
@@ -219,6 +310,17 @@ def ring_attention_bthd(
         raise ValueError(
             f"ring attention needs seq_len divisible by the '{axis}' axis: "
             f"T={T}, {axis}={sp}"
+        )
+    if use_flash is None:
+        from gpt_2_distributed_tpu.ops.flash_attention import pick_block_q
+
+        # Platform-gated like attention.py's flash auto-select: in interpret
+        # mode (CPU) the Pallas path is orders of magnitude slower than the
+        # XLA einsum ring, so auto only picks it on real TPU; tests force it
+        # with use_flash=True.
+        use_flash = (
+            jax.devices()[0].platform == "tpu"
+            and pick_block_q(T // sp) is not None
         )
     rate = float(dropout_rate) if (not deterministic and rng is not None) else 0.0
     if rate > 0.0:
@@ -237,6 +339,7 @@ def ring_attention_bthd(
         b_shard_axes=b_axes,
         h_shard_axes=h_axes,
         dropout_rate=rate,
+        use_flash=use_flash,
     )
     return jax.shard_map(
         local, mesh=mesh,

@@ -43,7 +43,7 @@ def main():
     p.add_argument("--block_q", type=int, default=None)
     p.add_argument("--block_k", type=int, default=None)
     p.add_argument("--dropout", type=float, default=0.0)
-    p.add_argument("--impl", default="flash", choices=["flash", "dense"])
+    p.add_argument("--impl", default="flash", choices=["flash", "dense", "block"])
     p.add_argument("--bwd", action="store_true", help="time fwd+bwd")
     args = p.parse_args()
 
@@ -54,7 +54,24 @@ def main():
     v = jnp.asarray(r.standard_normal((B, H, T, D)), jnp.bfloat16)
     key = jax.random.PRNGKey(0)
 
-    if args.impl == "flash":
+    if args.impl == "block":
+        # Ring-step microbench (round-4 VERDICT item 4 "done" criterion):
+        # one ring step = one flash_block call at local shapes. Time the
+        # fully-unmasked off-diagonal case (the common ring step, FULL TxT
+        # work) and report TF/s against those dense-useful flops — compare
+        # with --impl flash at the same T (causal-useful accounting).
+        from gpt_2_distributed_tpu.ops.flash_block import flash_block
+
+        def base(q, k, v):
+            o, lse = flash_block(
+                q, k, v, jnp.int32(T), jnp.int32(0),
+                seed=jax.random.randint(key, (1,), 0, 2**31 - 1, jnp.int32),
+                dropout_rate=args.dropout,
+                block_q=args.block_q, block_k=args.block_k,
+            )
+            return o + lse.astype(o.dtype)  # depend on both outputs
+
+    elif args.impl == "flash":
         det = args.dropout == 0.0
         base = lambda q, k, v: flash_attention(
             q, k, v, dropout_rate=args.dropout, rng=key,
@@ -88,7 +105,8 @@ def main():
     t2 = timed(args.iters * 2)
     dt = (t2 - t1) / args.iters
 
-    causal_flops = n_mm * 2 * 2 * B * H * T * T * D / 2  # /2: causal-useful
+    useful = 1.0 if args.impl == "block" else 0.5  # block: full TxT work
+    causal_flops = n_mm * 2 * 2 * B * H * T * T * D * useful
     print(
         f"{args.impl} block_q={args.block_q} block_k={args.block_k} "
         f"dropout={args.dropout} "
