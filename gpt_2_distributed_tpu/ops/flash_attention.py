@@ -151,18 +151,24 @@ def _dropout_bits(seed, b, h, row_off, col_off, shape):
     return dropout_hash_bits(seed, b, h, row, col)
 
 
-def _causal_gates(qi, j, bq, bk):
+def _causal_gates(qi, j, bq, bk, row_off=0, col_off=0):
     """(needed, fully_unmasked, is_last) for a [bq, bk] block at grid step
-    (qi, j) of a causal schedule with independent q/k block sizes.
+    (qi, j) of a causal schedule with independent q/k block sizes. Query
+    rows start at global ``row_off``, key columns at ``col_off`` (zero for
+    self-attention; ring blocks pass traced offsets — flash_block.py).
 
     needed: the block intersects the causal (lower-triangular) region.
     fully_unmasked: every (row, col) in the block satisfies col <= row, so
     the triangular mask (2 iotas + compare + select VPU passes) can be
-    skipped.  is_last: j is the final needed k-block for this q-block — the
-    online accumulators are complete and outputs can be written."""
-    needed = j * bk < (qi + 1) * bq
-    fully_unmasked = (j + 1) * bk - 1 <= qi * bq
-    last_j = ((qi + 1) * bq + bk - 1) // bk - 1
+    skipped.  is_last: j is the final k-block that can contribute to this
+    q-block — the online accumulators are complete and outputs must be
+    written (clamped to the grid so a fully-masked q-block still writes its
+    degenerate outputs at j == 0)."""
+    r_hi = row_off + (qi + 1) * bq - 1  # last global row of the q-block
+    c0 = col_off + j * bk               # first global col of the k-block
+    needed = c0 <= r_hi
+    fully_unmasked = c0 + bk - 1 <= row_off + qi * bq
+    last_j = jnp.clip((r_hi - col_off) // bk, 0, pl.num_programs(3) - 1)
     return needed, fully_unmasked, j == last_j
 
 

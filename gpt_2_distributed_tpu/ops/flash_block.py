@@ -54,6 +54,7 @@ from jax.experimental.pallas import tpu as pltpu
 from gpt_2_distributed_tpu.ops.flash_attention import (
     LOG2E,
     NEG_INF,
+    _causal_gates,
     _dropout_bits,
     pick_block_q,
 )
@@ -62,11 +63,6 @@ from gpt_2_distributed_tpu.ops.flash_attention import (
 # revisited dk/dv accumulators need qi "arbitrary".
 _FWD_DIMS = ("parallel", "parallel", "parallel", "arbitrary")
 _BWD_DIMS = ("parallel", "parallel", "arbitrary", "arbitrary")
-
-# One dropout-bit generator for every attention path: flash_attention's
-# _dropout_bits already hashes absolute coordinates at vector width — this
-# module just feeds it GLOBAL (b, h, row, col) origins.
-_global_dropout_bits = _dropout_bits
 
 
 def _fwd_kernel(
@@ -82,7 +78,6 @@ def _fwd_kernel(
     *,
     block_q: int,
     block_k: int,
-    n_k: int,
     dropout_rate: float,
 ):
     b, h, qi, j = (pl.program_id(0), pl.program_id(1),
@@ -94,17 +89,12 @@ def _fwd_kernel(
     row_off = scalars_ref[1]
     col_off = scalars_ref[2]
 
-    # Global origins of this (qi, j) tile.
+    # Global origins of this (qi, j) tile; gates shared with the
+    # self-attention kernels (traced offsets vary per ring step under scan).
     r0 = row_off + qi * bq
     c0 = col_off + j * bk
-    # Causal gates on global coordinates (traced scalars — offsets vary per
-    # ring step under lax.scan).
-    needed = c0 <= r0 + bq - 1
-    fully_unmasked = c0 + bk - 1 <= r0
-    # Last contributing k-block for this q-block; when none contributes the
-    # j == 0 step writes the degenerate (0, NEG_INF) outputs.
-    last_j = jnp.clip((r0 + bq - 1 - col_off) // bk, 0, n_k - 1)
-    is_last = j == last_j
+    needed, fully_unmasked, is_last = _causal_gates(
+        qi, j, bq, bk, row_off, col_off)
 
     @pl.when(j == 0)
     def _init():
@@ -136,7 +126,7 @@ def _fwd_kernel(
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
-            bits = _global_dropout_bits(
+            bits = _dropout_bits(
                 seed, scalars_ref[3] + b, scalars_ref[4] + h, r0, c0, s.shape
             )
             threshold = jnp.uint32(int(dropout_rate * (2**32)))
@@ -178,7 +168,6 @@ def _bwd_kernel(
     *,
     block_q: int,
     block_k: int,
-    n_k: int,
     dropout_rate: float,
 ):
     b, h, qi, j = (pl.program_id(0), pl.program_id(1),
@@ -192,10 +181,8 @@ def _bwd_kernel(
     col_off = scalars_ref[2]
     r0 = row_off + qi * bq
     c0 = col_off + j * bk
-    needed = c0 <= r0 + bq - 1
-    fully_unmasked = c0 + bk - 1 <= r0
-    last_j = jnp.clip((r0 + bq - 1 - col_off) // bk, 0, n_k - 1)
-    is_last = j == last_j
+    needed, fully_unmasked, is_last = _causal_gates(
+        qi, j, bq, bk, row_off, col_off)
 
     @pl.when((qi == 0) & (j == 0))
     def _init_kv():
@@ -231,7 +218,7 @@ def _bwd_kernel(
             preferred_element_type=jnp.float32,
         )
         if dropout_rate > 0.0:
-            bits = _global_dropout_bits(
+            bits = _dropout_bits(
                 seed, scalars_ref[3] + b, scalars_ref[4] + h, r0, c0, s.shape
             )
             keep = bits >= jnp.uint32(int(dropout_rate * (2**32)))
@@ -298,7 +285,7 @@ def _build(dropout_rate: float, block_q: int, block_k: int, interpret: bool):
         )
         return pl.pallas_call(
             functools.partial(
-                _fwd_kernel, block_q=block_q, block_k=block_k, n_k=nk,
+                _fwd_kernel, block_q=block_q, block_k=block_k,
                 dropout_rate=dropout_rate,
             ),
             grid_spec=grid_spec,
@@ -345,7 +332,7 @@ def _build(dropout_rate: float, block_q: int, block_k: int, interpret: bool):
         )
         return pl.pallas_call(
             functools.partial(
-                _bwd_kernel, block_q=block_q, block_k=block_k, n_k=nk,
+                _bwd_kernel, block_q=block_q, block_k=block_k,
                 dropout_rate=dropout_rate,
             ),
             grid_spec=grid_spec,

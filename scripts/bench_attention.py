@@ -69,7 +69,10 @@ def main():
                 dropout_rate=args.dropout,
                 block_q=args.block_q, block_k=args.block_k,
             )
-            return o + lse.astype(o.dtype)  # depend on both outputs
+            # Depend on both outputs, BOUNDEDLY: lse is linear in |q|, so
+            # feeding it raw into the chained q update diverges to inf/NaN
+            # within ~50 iterations and the bench would time NaN operands.
+            return o + (jnp.tanh(lse) * 1e-3).astype(o.dtype)
 
     elif args.impl == "flash":
         det = args.dropout == 0.0

@@ -1,0 +1,151 @@
+"""Dropout-ON parity: the production training mode's statistical evidence.
+
+Round-3 VERDICT weak-point (item 8): the committed 124M kernel overlays
+(PARITY_CURVES.json) train dropout-OFF, while production trains dropout-ON
+with a counter-based hash RNG stream that torch cannot reproduce
+(/root/reference/model.py:145-146,188 are the reference's dropout sites).
+Exact curve parity is impossible by construction — different streams draw
+different masks — so the right evidence is statistical:
+
+* N production runs (flash+blocked, dropout 0.1) differing ONLY in the
+  dropout seed define the dropout-noise band: how much the curve moves when
+  nothing changes but the masks.
+* A dense-kernel run (XLA attention + jax.random threefry dropout — a
+  completely different stream IMPLEMENTATION, the closest analogue to
+  "torch's stream vs ours") must land inside that band: if swapping the
+  entire dropout implementation moves the curve no more than re-seeding the
+  same implementation does, the hash stream carries no training bias.
+
+Writes PARITY_DROPOUT.json; PARITY.md §4 summarizes the recorded run.
+
+Usage: PYTHONPATH=. python scripts/parity_dropout.py [--steps 300] [--seeds 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--seeds", type=int, default=3)
+    p.add_argument("--out", default="PARITY_DROPOUT.json")
+    args = p.parse_args()
+
+    import jax
+
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    # PRODUCTION configuration: dropout ON at the preset rates (0.1).
+    base = MODEL_PRESETS["124M"]
+    assert base.attn_dropout > 0 and base.resid_dropout > 0
+
+    # Same deterministic learnable stream as parity_curves.py.
+    rng = np.random.default_rng(1)
+    starts = rng.integers(0, base.vocab_size, (args.steps, args.batch, 1))
+    seqs = (starts + np.arange(args.seq + 1)) % base.vocab_size
+    xs = seqs[:, :, :-1].astype(np.int32)
+    ys = seqs[:, :, 1:].astype(np.int32)
+
+    runs = [
+        (f"prod-dropout-seed{s}",
+         dict(attention_impl="flash", loss_impl="blocked"), s)
+        for s in range(args.seeds)
+    ]
+    # Different dropout stream IMPLEMENTATION (jax.random in the dense path
+    # vs the kernels' counter hash), same seed index as run 0.
+    runs.append(
+        ("dense-stream-seed0",
+         dict(attention_impl="dense", loss_impl="blocked"), 0)
+    )
+
+    result = {
+        "model": "124M",
+        "steps": args.steps,
+        "batch": args.batch,
+        "seq": args.seq,
+        "lr": args.lr,
+        "dropout": {
+            "embd": base.embd_dropout,
+            "attn": base.attn_dropout,
+            "resid": base.resid_dropout,
+        },
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "curves": {},
+    }
+    for name, overrides, seed in runs:
+        cfg = base.replace(**overrides)
+        params = gpt2.init_params(cfg, seed=42)  # identical init everywhere
+        opt = make_optimizer(args.lr)
+        opt_state = opt.init(params)
+        step = make_train_step(cfg, opt)
+        key = jax.random.PRNGKey(seed)
+        losses = []
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            params, opt_state, m = step(
+                params, opt_state, xs[i][None], ys[i][None], key, i
+            )
+            losses.append(float(m.loss))
+        jax.block_until_ready(m.loss)
+        dt = time.perf_counter() - t0
+        result["curves"][name] = {
+            "losses": losses,
+            "wall_s": round(dt, 1),
+        }
+        print(
+            f"{name}: loss {losses[0]:.3f} -> {losses[-1]:.4f} ({dt:.0f}s)",
+            flush=True,
+        )
+
+    # Band analysis. The seed band at step t is the max pairwise |Δ| among
+    # the production seeds; the dense-stream run's distance to the NEAREST
+    # production curve is compared to it (cumulative-max smoothed: chaos
+    # makes per-step bands spiky, what matters is the envelope).
+    prod = np.stack([
+        result["curves"][f"prod-dropout-seed{s}"]["losses"]
+        for s in range(args.seeds)
+    ])
+    band = prod.max(axis=0) - prod.min(axis=0)
+    dense = np.asarray(result["curves"]["dense-stream-seed0"]["losses"])
+    dist = np.abs(dense[None] - prod).min(axis=0)
+    env_band = np.maximum.accumulate(band)
+    env_dist = np.maximum.accumulate(dist)
+    finals = prod[:, -1].tolist() + [float(dense[-1])]
+    result["analysis"] = {
+        "seed_band_max": float(band.max()),
+        "seed_band_final": float(band[-1]),
+        "dense_dist_max": float(dist.max()),
+        "dense_dist_final": float(dist[-1]),
+        "dense_within_seed_envelope_frac": float(
+            (env_dist <= np.maximum(env_band, 1e-3)).mean()
+        ),
+        "final_losses": finals,
+        "final_spread": float(max(finals) - min(finals)),
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    a = result["analysis"]
+    print(
+        f"seed band max {a['seed_band_max']:.3f}; dense-stream dist max "
+        f"{a['dense_dist_max']:.3f}; final spread {a['final_spread']:.4f}"
+    )
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
