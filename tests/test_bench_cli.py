@@ -1,0 +1,43 @@
+"""bench.py CLI contract (jax-free: arg handling only).
+
+The driver runs plain ``python bench.py`` and parses ONE JSON line; since
+round 4 that default runs the 4-config suite so BENCH_r* third-party-records
+every headline claim. These tests pin the arg surface without touching jax
+(all failures happen at parse time, before the deferred jax import).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(*argv):
+    return subprocess.run(
+        [sys.executable, BENCH, *argv], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_help_is_fast_and_jax_free():
+    r = _run("--help")
+    assert r.returncode == 0
+    assert "--suite" in r.stdout
+
+
+def test_suite_rejects_single_config_flags():
+    r = _run("--suite", "--model", "345M")
+    assert r.returncode != 0
+    assert "drop --model" in r.stderr
+
+
+def test_default_suite_rejects_operating_point_overrides():
+    # No --model/--seq_len => suite mode; a forced batch cannot fit all four
+    # configs (e.g. b8 OOMs 345M@1024 without remat).
+    r = _run("--batch", "8")
+    assert r.returncode != 0
+    assert "drop --batch" in r.stderr

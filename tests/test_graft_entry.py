@@ -65,7 +65,10 @@ def test_dryrun_multichip_backend_reinit_fallback():
     must actually work, not just exist."""
     r = _run(
         "import __graft_entry__ as g\n"
-        "g.dryrun_multichip(8)\n"
+        # presets=False: the subject here is the backend re-init path; the
+        # real-width preset proofs run in the other dryrun test and in
+        # test_parallel.py.
+        "g.dryrun_multichip(8, presets=False)\n"
         "print('DRYRUN_FALLBACK_OK')\n",
         drop_device_count_flag=True,
     )
