@@ -108,11 +108,25 @@ def main() -> None:
     if suite:
         if args.model is not None or args.seq_len is not None:
             p.error("--suite benches the fixed config set; drop --model/--seq_len")
-        if args.batch or args.grad_accum_steps:
-            # A single forced operating point cannot fit all four configs
-            # (e.g. --batch 8 OOMs 345M@1024); each config auto-picks.
-            p.error("--suite picks per-config operating points; drop "
-                    "--batch/--grad_accum_steps")
+        overrides = [
+            flag for flag, hit in (
+                ("--batch", args.batch),
+                ("--grad_accum_steps", args.grad_accum_steps),
+                ("--remat", args.remat is not None),
+                ("--scan_layers", args.scan_layers != "auto"),
+                ("--unroll_accum", args.unroll_accum),
+                ("--loss_block_rows", args.loss_block_rows),
+            ) if hit
+        ]
+        if overrides:
+            # One forced operating point cannot fit all four configs (e.g.
+            # --batch 8 OOMs 345M@1024), and a global remat/scan/CE override
+            # would record suite numbers that aren't the headline claims.
+            # Each config auto-picks; name a --model/--seq_len to sweep.
+            p.error(
+                f"the suite picks per-config operating points; drop "
+                f"{'/'.join(overrides)} or name a single config"
+            )
         records = []
         for model, seq_len in SUITE_CONFIGS:
             records.append(run_config(args, model=model, seq_len=seq_len))
@@ -172,18 +186,19 @@ def run_config(args, model: str, seq_len: int) -> dict:
         micro_batch = args.batch
     elif not on_tpu:
         micro_batch = 2
-    elif model == "345M":
-        # b6 is the largest micro-batch that fits 345M WITHOUT remat on a
-        # 16G chip — and no-remat beats remat=mlp's MLP replay: 51.7% vs
-        # 48.1% MFU (round-3 sweep, PERF_ANALYSIS.md §5).
-        micro_batch = 6
     elif small_model and seq_len >= 2048:
         # Long context wants ~8k tokens per micro-batch (the swept optimum's
         # invariant): b8@2048 reads 48.7% MFU where b4 reads 50.5%, and
         # b8@4096 reads 48.5% where b2 reads 50.7% (round-4 sweep) — larger
         # micro-batches lose more to memory pressure than their matmul
-        # shapes gain, exactly as at seq 1024.
+        # shapes gain, exactly as at seq 1024. The same picks carry 345M:
+        # 51.1% @2048 b4a16, 52.6% @4096 b2a32 (b6 would blow 16G HBM).
         micro_batch = max(1, 8192 // seq_len)
+    elif model == "345M":
+        # b6 is the largest micro-batch that fits 345M WITHOUT remat on a
+        # 16G chip — and no-remat beats remat=mlp's MLP replay: 51.7% vs
+        # 48.1% MFU (round-3 sweep, PERF_ANALYSIS.md §5).
+        micro_batch = 6
     else:
         micro_batch = 8 if small_model else 4
     if args.grad_accum_steps:

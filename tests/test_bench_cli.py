@@ -16,28 +16,50 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
-def _run(*argv):
+def _run(*argv, poison_jax_dir=None):
+    env = dict(os.environ)
+    if poison_jax_dir is not None:
+        env["PYTHONPATH"] = poison_jax_dir + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.run(
-        [sys.executable, BENCH, *argv], cwd=REPO,
+        [sys.executable, BENCH, *argv], cwd=REPO, env=env,
         capture_output=True, text=True, timeout=120,
     )
 
 
-def test_help_is_fast_and_jax_free():
-    r = _run("--help")
-    assert r.returncode == 0
+def _poison(tmp_path):
+    """A jax.py that explodes on import: parse-time paths must never reach
+    it (bench.py defers every jax-touching import until after parse_args)."""
+    d = tmp_path / "poison"
+    d.mkdir()
+    (d / "jax.py").write_text("raise ImportError('bench touched jax at parse time')")
+    return str(d)
+
+
+def test_help_is_jax_free(tmp_path):
+    r = _run("--help", poison_jax_dir=_poison(tmp_path))
+    assert r.returncode == 0, r.stderr[-500:]
     assert "--suite" in r.stdout
 
 
-def test_suite_rejects_single_config_flags():
-    r = _run("--suite", "--model", "345M")
+def test_suite_rejects_single_config_flags(tmp_path):
+    r = _run("--suite", "--model", "345M", poison_jax_dir=_poison(tmp_path))
     assert r.returncode != 0
     assert "drop --model" in r.stderr
 
 
-def test_default_suite_rejects_operating_point_overrides():
-    # No --model/--seq_len => suite mode; a forced batch cannot fit all four
-    # configs (e.g. b8 OOMs 345M@1024 without remat).
-    r = _run("--batch", "8")
-    assert r.returncode != 0
-    assert "drop --batch" in r.stderr
+def test_default_suite_rejects_operating_point_overrides(tmp_path):
+    # No --model/--seq_len => suite mode; forced operating points or global
+    # remat/CE overrides would record suite numbers that aren't the headline
+    # claims (e.g. b8 OOMs 345M@1024; --remat mlp reads ~48% at 124M).
+    poison = _poison(tmp_path)
+    for flags, named in (
+        (("--batch", "8"), "--batch"),
+        (("--grad_accum_steps", "4"), "--grad_accum_steps"),
+        (("--remat", "mlp"), "--remat"),
+        (("--unroll_accum",), "--unroll_accum"),
+        (("--loss_block_rows", "512"), "--loss_block_rows"),
+        (("--scan_layers", "on"), "--scan_layers"),
+    ):
+        r = _run(*flags, poison_jax_dir=poison)
+        assert r.returncode != 0, flags
+        assert named in r.stderr, (flags, r.stderr[-300:])
