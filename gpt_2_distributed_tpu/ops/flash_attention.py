@@ -238,6 +238,14 @@ def _fwd_kernel(
 
     @pl.when(is_last)
     def _finalize():
+        # INVARIANT: this kernel addresses K/V from column 0 (col_off == 0),
+        # so the j == 0 block always contains each row's own diagonal — every
+        # row has >= 1 unmasked lane and l > 0 here. That is why, unlike
+        # flash_block.py's offset-aware finalize, there is no
+        # where(mask, ...) guard on p and no guarded divide: reusing this
+        # kernel with a nonzero column offset would leak exp2(NEG_INF-m)
+        # rows and divide by zero. Offset-addressed callers must use
+        # flash_block.flash_attention_block instead.
         l = l_scr[...]
         lse_ref[0, 0] = m_scr[...] + jnp.log2(l)
         o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
@@ -501,7 +509,7 @@ def flash_attention(
     if block_q is None:
         raise ValueError(
             f"flash attention needs T divisible by a viable block size "
-            f"(512/256/128), got T={t}"
+            f"(1024/512/256/128), got T={t}"
         )
     block_k = pick_block_q(t, block_k if block_k is not None else dk_)
     if interpret is None:

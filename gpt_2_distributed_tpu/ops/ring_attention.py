@@ -318,6 +318,10 @@ def ring_attention_bthd(
         # mode (CPU) the Pallas path is orders of magnitude slower than the
         # XLA einsum ring, so auto only picks it on real TPU; tests force it
         # with use_flash=True.
+        # One pick_block_q(T // sp) check covers BOTH kernel operands only
+        # because the ring passes full tl-sized K/V blocks, so Tq == Tc == tl
+        # (flash_block also needs Tc to divide a viable block). If the ring
+        # ever passes differently-sized K/V chunks, gate on both lengths.
         use_flash = (
             jax.devices()[0].platform == "tpu"
             and pick_block_q(T // sp) is not None

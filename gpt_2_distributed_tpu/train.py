@@ -103,6 +103,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=DEFAULT_SEED)
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint in --save_dir")
     p.add_argument(
+        "--inject_fail_at", type=int, default=0,
+        help="fault injection for elastic-restart testing (SURVEY.md §5.3 — "
+        "the reference has none): hard-exit rc 13 the first time optimizer "
+        "step N completes. One-shot via a marker file in --save_dir, so a "
+        "supervised relaunch (scripts/supervise.sh) proves resume-after-"
+        "crash end-to-end. 0 = off; requires --save_dir.",
+    )
+    p.add_argument(
         "--remat", nargs="?", const="block", default=False,
         choices=["block", "mlp", "dots"],
         help="activation checkpointing: 'block' (full, lowest memory; the "
@@ -209,6 +217,8 @@ def make_lr_schedule(args, steps_per_epoch: int):
 
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
+    if args.inject_fail_at and not args.save_dir:
+        build_parser().error("--inject_fail_at needs --save_dir (one-shot marker + resume target)")
 
     # Honor --device (highest priority) then JAX_PLATFORMS, even when a site
     # boot hook force-registered a different backend before us (observed: an
@@ -516,6 +526,22 @@ def main(argv: list[str] | None = None) -> None:
                             total_tokens=tracker.total_tokens,
                         ),
                     )
+                if args.inject_fail_at and global_step >= args.inject_fail_at:
+                    marker = os.path.join(
+                        args.save_dir, f".fail_injected_{args.inject_fail_at}"
+                    )
+                    if not os.path.exists(marker):
+                        flush_pending()
+                        tracker.close()
+                        os.makedirs(args.save_dir, exist_ok=True)
+                        with open(marker, "w") as f:
+                            f.write(str(global_step))
+                        print(
+                            f"[inject] simulated failure after step {global_step}",
+                            flush=True,
+                        )
+                        # Hard exit, no teardown/final-save: model a real crash.
+                        os._exit(13)
                 if args.max_steps and global_step >= args.max_steps:
                     done = True
                     break

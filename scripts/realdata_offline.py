@@ -1,0 +1,176 @@
+"""Real-data training evidence under a zero-egress sandbox.
+
+The reference's entire purpose is FineWeb pretraining
+(``/root/reference/train_gpt2_distributed.py:336-347``, notebook cells 3-13),
+but this sandbox has no network egress (DNS resolution fails for
+huggingface.co and openaipublic.blob.core.windows.net — so neither the
+FineWeb parquet download nor the tiktoken GPT-2 BPE vocabulary fetch can
+run). This script produces the honest substitute, in two parts:
+
+1. ``--attempt-fineweb``: actually run the real pipeline entry
+   (``tokenize_fineweb`` main path) and record the failure verbatim — the
+   "record the failed attempt explicitly" half of round-4 VERDICT item #2.
+
+2. ``--out_dir ...``: build the best-available REAL-TEXT corpus present on
+   this machine — natural-language documentation English (module/class/
+   function docstrings extracted via ``ast`` from the installed
+   site-packages Python sources, plus plain-text files under
+   /usr/share/doc) — and tokenize it through the pipeline's offline byte
+   codec (``tokenize_fineweb.ByteEncoder``) into the exact shard format the
+   trainer consumes (uint16 ``.bin``, EOT-prepended docs, shard 0 = val,
+   ``metadata.json``). This is real human text through the real pipeline —
+   NOT FineWeb and NOT GPT-2 BPE; REALDATA.md carries the caveats.
+
+Usage::
+
+    python scripts/realdata_offline.py --attempt-fineweb
+    python scripts/realdata_offline.py --out_dir /tmp/realtext_shards \
+        --max_tokens 60000000 --shard_size 10000000
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def attempt_fineweb() -> dict:
+    """Run the real FineWeb path far enough to hit the network; record how
+    it fails. Returns the attempt record (also printed as JSON)."""
+    record: dict = {"attempted": time.strftime("%Y-%m-%d %H:%M:%S %Z")}
+
+    import socket
+
+    for host in ("huggingface.co", "openaipublic.blob.core.windows.net"):
+        try:
+            socket.getaddrinfo(host, 443)
+            record[host] = "resolves"
+        except OSError as e:
+            record[host] = f"DNS failure: {e}"
+
+    try:
+        import tiktoken
+
+        tiktoken.get_encoding("gpt2")
+        record["tiktoken_gpt2_bpe"] = "loaded"
+    except Exception as e:  # noqa: BLE001 — recording, not handling
+        record["tiktoken_gpt2_bpe"] = f"{type(e).__name__}: {str(e)[:300]}"
+
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset(
+            "HuggingFaceFW/fineweb", name="sample-10BT",
+            split="train", streaming=True,
+        )
+        next(iter(ds))
+        record["fineweb_stream"] = "ok"
+    except Exception as e:  # noqa: BLE001
+        record["fineweb_stream"] = f"{type(e).__name__}: {str(e)[:300]}"
+    return record
+
+
+def _printable_fraction(text: str) -> float:
+    if not text:
+        return 0.0
+    ok = sum(ch.isprintable() or ch in "\n\t " for ch in text)
+    return ok / len(text)
+
+
+def iter_docstring_documents(roots: list[str]):
+    """Yield {"text": ...} rows of natural-language documentation extracted
+    from Python sources: every module/class/function docstring in each file,
+    concatenated into one document per file (mirroring FineWeb's
+    one-web-page-per-document granularity)."""
+    for root in roots:
+        for path in sorted(glob.glob(os.path.join(root, "**", "*.py"), recursive=True)):
+            try:
+                with open(path, encoding="utf-8", errors="ignore") as f:
+                    tree = ast.parse(f.read())
+            except (SyntaxError, ValueError, OSError):
+                continue
+            parts = []
+            for node in ast.walk(tree):
+                if isinstance(
+                    node,
+                    (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+                ):
+                    doc = ast.get_docstring(node, clean=True)
+                    if doc and len(doc) > 40:
+                        parts.append(doc)
+            text = "\n\n".join(parts)
+            if len(text) > 400:
+                yield {"text": text}
+
+
+def iter_plaintext_documents(roots: list[str], max_bytes: int = 512 * 1024):
+    """Yield plain-text files (README/changelog/copyright prose) that decode
+    as mostly-printable UTF-8."""
+    for root in roots:
+        for path in sorted(glob.glob(os.path.join(root, "**", "*"), recursive=True)):
+            if not os.path.isfile(path) or os.path.getsize(path) > max_bytes:
+                continue
+            if os.path.splitext(path)[1] in (".gz", ".png", ".jpg", ".mo", ".so"):
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except (UnicodeDecodeError, OSError):
+                continue
+            if len(text) > 400 and _printable_fraction(text) > 0.97:
+                yield {"text": text}
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--attempt-fineweb", action="store_true",
+                   help="run the real FineWeb path and print the failure record")
+    p.add_argument("--out_dir", default=None,
+                   help="build byte-codec shards from on-disk real text")
+    p.add_argument("--max_tokens", type=int, default=60_000_000)
+    p.add_argument("--shard_size", type=int, default=10_000_000)
+    p.add_argument("--py_roots", nargs="*", default=None,
+                   help="roots to scan for Python docstrings (default: site-packages)")
+    args = p.parse_args(argv)
+
+    if args.attempt_fineweb:
+        print(json.dumps(attempt_fineweb(), indent=2))
+        return
+    if not args.out_dir:
+        p.error("need --attempt-fineweb or --out_dir")
+
+    from gpt_2_distributed_tpu.data.tokenize_fineweb import tokenize_corpus
+
+    if args.py_roots is None:
+        import site
+
+        args.py_roots = site.getsitepackages()
+
+    def rows():
+        yield from iter_plaintext_documents(["/usr/share/doc"])
+        yield from iter_docstring_documents(args.py_roots)
+
+    t0 = time.time()
+    # num_procs=1: the corpus iterator is the bottleneck (ast parsing) and
+    # this host has one core; pool pickling would only add overhead.
+    meta = tokenize_corpus(
+        rows(), args.out_dir, dataset_name="realtext",
+        shard_size=args.shard_size, num_procs=1,
+        max_tokens=args.max_tokens, encoding="byte",
+    )
+    meta["build_seconds"] = round(time.time() - t0, 1)
+    meta["sources"] = {"plaintext": "/usr/share/doc", "docstrings": args.py_roots}
+    print(json.dumps({k: v for k, v in meta.items() if k != "shards"}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,8 +22,18 @@ effect (per-call sync), which is how the "98.3 TF/s matmul ceiling" was
 derived — that number contains ~90 ms of host round-trip per measured call.
 Every measurement here therefore (a) runs its iteration loop INSIDE one jit
 via ``lax.fori_loop`` (sequential by data dependence, so the compiler cannot
-collapse it), and (b) issues several such calls back-to-back and syncs ONCE
-at the end, the same async-dispatch regime the bench's train loop runs in.
+collapse it), (b) issues several such calls back-to-back and syncs ONCE
+at the end, the same async-dispatch regime the bench's train loop runs in,
+and (c) — since round 5 — is MARGINAL: the whole (b) procedure runs at
+``inner`` and ``2*inner`` chained applications and the two times are
+differenced, so every constant per-run cost (dispatch floor, final sync,
+warm-cache effects) cancels exactly. (c) is what ``bench_attention.py``
+introduced in round 4; the round-4 ROOFLINE refresh attempt showed why it
+is necessary here too: one-sided in-jit loops reproduced the big-matmul
+ceiling exactly but read SHORT measurements 40-60% low under that day's
+tunnel conditions — a constant adverse offset the marginal cancels. The
+median over ``--repeats`` pairs guards against a transient landing inside
+one leg of the difference.
 
 Measurements:
 
@@ -58,6 +68,11 @@ def main() -> None:
     p.add_argument("--out", default="ROOFLINE.json")
     p.add_argument("--outer", type=int, default=4, help="timed jit calls; best taken")
     p.add_argument("--inner", type=int, default=INNER)
+    p.add_argument(
+        "--repeats", type=int, default=3,
+        help="marginal (inner vs 2*inner) timing pairs per measurement; "
+        "median taken",
+    )
     args = p.parse_args()
 
     import jax
@@ -72,26 +87,50 @@ def main() -> None:
         "platform": dev.platform,
         "nameplate_bf16_tf": (device_peak_flops() or 0) / 1e12,
         "inner_iters": args.inner,
+        "method": "marginal",  # (t[2*inner] - t[inner]) / inner, median of repeats
+        "repeats": args.repeats,
         "measurements": {},
     }
     rng = np.random.default_rng(0)
 
     def time_looped(jitted, operands, sync, rewrap=None):
-        """Per-application device time of `jitted` (which runs `inner`
-        chained applications internally): `outer` calls issued back-to-back
-        with the output fed back as input (device stays busy, data-dependent
-        so nothing collapses), ONE sync at the end — the per-call host
-        round-trip overlaps dispatch exactly as in the train loop."""
+        """MARGINAL per-application device time of `jitted` (which runs its
+        last operand = `inner` chained applications internally): `outer`
+        calls issued back-to-back with the output fed back as input (device
+        stays busy, data-dependent so nothing collapses), ONE sync at the
+        end — then the whole procedure repeated at 2x `inner` and the two
+        times differenced, cancelling every constant per-run cost (dispatch
+        floor, final sync, tunnel round-trip). Median over `repeats` pairs."""
         if rewrap is None:
             rewrap = lambda y, ops: (y,) + tuple(ops[1:])
-        y = jitted(*operands)  # compile + warm
-        sync(y)
-        t0 = time.perf_counter()
-        for _ in range(args.outer):
-            operands = rewrap(y, operands)
-            y = jitted(*operands)
-        sync(y)
-        return (time.perf_counter() - t0) / (args.outer * args.inner)
+
+        def run_once(inner):
+            ops = operands[:-1] + (inner,)
+            y = jitted(*ops)  # compile (cached after first pair) + warm
+            sync(y)
+            t0 = time.perf_counter()
+            for _ in range(args.outer):
+                ops = rewrap(y, ops)
+                y = jitted(*ops)
+            sync(y)
+            return time.perf_counter() - t0
+
+        for attempt in range(2):
+            marginals = []
+            for _ in range(args.repeats):
+                t1 = run_once(args.inner)
+                t2 = run_once(2 * args.inner)
+                marginals.append((t2 - t1) / (args.outer * args.inner))
+            dt = float(np.median(marginals))
+            if dt > 0:
+                return dt
+            # A transient landing inside one leg can push the difference
+            # non-positive; one full re-run, then fail loudly rather than
+            # committing a negative/inf rate to ROOFLINE.json.
+        raise RuntimeError(
+            f"non-positive marginal time ({marginals}) after retry — "
+            "tunnel too noisy; re-run when idle"
+        )
 
     sync_mat = lambda y: float(jnp.sum(y[0, :8].astype(jnp.float32)))
 

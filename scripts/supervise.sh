@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Supervised-restart wrapper — the process-level restart-on-failure role
+# torchrun plays for the reference's launchers
+# (/root/reference/scripts/run_training_distributed_fsdp_main.sh:15-20).
+# torchrun restarts a crashed worker group from scratch; since our
+# load_checkpoint is real (the reference's is a stub,
+# /root/reference/train_gpt2_distributed.py:104-111), a restart here actually
+# RESUMES: --resume is appended to every launch, which picks up the latest
+# checkpoint in --save_dir or starts fresh when none exists yet, so the
+# wrapper is idempotent across attempts.
+#
+# Usage:
+#   ./scripts/supervise.sh ./scripts/run_training_fsdp.sh DATA_DIR [flags...]
+#   MAX_RESTARTS=5 ./scripts/supervise.sh python -m gpt_2_distributed_tpu.train \
+#       --data_dir DATA --save_dir ckpt ...
+#
+# Env knobs: MAX_RESTARTS (default 3) bounds relaunches, matching torchrun's
+# --max_restarts; RESTART_DELAY seconds between attempts (default 2).
+set -uo pipefail  # no -e: the exit code is inspected, not fatal
+
+MAX_RESTARTS="${MAX_RESTARTS:-3}"
+RESTART_DELAY="${RESTART_DELAY:-2}"
+
+attempt=0
+while :; do
+    "$@" --resume
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+        exit 0
+    fi
+    if [ "$rc" -eq 130 ]; then
+        # SIGINT is an operator stop, not a failure — don't fight Ctrl-C.
+        echo "[supervise] interrupted (rc=130); not restarting" >&2
+        exit "$rc"
+    fi
+    attempt=$((attempt + 1))
+    if [ "$attempt" -gt "$MAX_RESTARTS" ]; then
+        echo "[supervise] giving up after ${MAX_RESTARTS} restarts (last rc=${rc})" >&2
+        exit "$rc"
+    fi
+    echo "[supervise] training exited rc=${rc}; restart ${attempt}/${MAX_RESTARTS}" \
+         "(--resume continues from the latest checkpoint)" >&2
+    sleep "$RESTART_DELAY"
+done

