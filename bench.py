@@ -9,7 +9,8 @@ BASELINE.md (the reference publishes no numbers of its own — BASELINE.json
 records ``"published": {}`` — so the target is forward-defined). On non-TPU
 hosts (unknown peak FLOPs) ``vs_baseline`` is null.
 
-``--suite`` runs every headline configuration ({124M,345M} × {1024,2048,4096})
+``--suite`` runs every headline configuration ({124M,345M} × {1024,2048,4096}
+plus the 774M single-chip operating point)
 and prints ONE JSON line holding the first successful record plus a
 ``"suite"`` array — so each round's driver-captured BENCH artifact
 third-party-records every claim, not just the default config (round-3
@@ -51,6 +52,7 @@ SUITE_CONFIGS = (
     ("124M", 4096),
     ("345M", 2048),
     ("345M", 4096),
+    ("774M", 1024),
 )
 
 
@@ -73,9 +75,10 @@ def main() -> None:
     p.add_argument("--seq_len", type=int, default=None)
     p.add_argument(
         "--suite", action="store_true",
-        help="run all headline configs ({124M,345M} x {1024,2048,4096}) and "
+        help="run all headline configs ({124M,345M} x {1024,2048,4096} plus "
+        "774M@1024 single-chip) and "
         "emit one JSON line with a 'suite' array. This is the DEFAULT when "
-        "neither --model nor --seq_len is given (~20 min on a v5e — the "
+        "neither --model nor --seq_len is given (~25 min on a v5e — the "
         "345M long-context compiles dominate) so the "
         "driver-captured BENCH artifact third-party-records every headline "
         "claim; name a config for a single ~1 min run. Per-config failures "
@@ -87,11 +90,11 @@ def main() -> None:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument(
         "--remat", nargs="?", const="block", default=None,
-        choices=["block", "mlp", "dots", "off"],
+        choices=["block", "mlp", "attn", "dots", "off"],
         help="activation checkpointing ('block' = whole block, 'mlp' = MLP "
         "sublayer only, 'dots' = save-matmul-outputs policy; bare flag "
         "means 'block'; 'off' forces none; default: off for 124M/345M, "
-        "'mlp' for larger presets)",
+        "'block' for single-chip 774M, 'mlp' for other large presets)",
     )
     p.add_argument(
         "--unroll_accum", action="store_true",
@@ -146,9 +149,13 @@ def main() -> None:
             records.append(run_config_resilient(args, model=model, seq_len=seq_len))
         # The first successful record is the headline (drivers read the
         # top-level metric); the full sweep rides along under "suite".
-        ok = [r for r in records if "error" not in r]
-        head = dict(ok[0] if ok else records[0])
-        if ok and (head["model"], head["seq_len"]) != SUITE_CONFIGS[0]:
+        # Compare on the REQUESTED config, not record fields — off-TPU runs
+        # clamp the recorded seq_len, which is not a failure.
+        ok = [
+            (cfg, r) for cfg, r in zip(SUITE_CONFIGS, records) if "error" not in r
+        ]
+        head = dict(ok[0][1] if ok else records[0])
+        if ok and ok[0][0] != SUITE_CONFIGS[0]:
             # Self-describing guard for round-over-round readers: the
             # headline is normally SUITE_CONFIGS[0] (124M@1024); if that
             # config double-failed, the first SUCCESSFUL record is promoted
@@ -168,57 +175,43 @@ def main() -> None:
 
 
 def run_config_resilient(args, model: str, seq_len: int) -> dict:
-    """One suite entry that cannot abort the capture.
+    """One suite entry that cannot abort or hang the capture.
 
-    Attempt 1 runs in-process (fast path) under a SIGALRM watchdog — a
-    wedged tunnel client that BLOCKS instead of raising must not hang the
-    whole capture. Any failure — a transient tunnel error (round 4 died to
-    ``remote_compile: read body closed``), an OOM, a compile bug, the
-    watchdog — gets ONE retry in a fresh ``python bench.py --model ...``
-    subprocess, because a failed remote-TPU call can leave the in-process
-    runtime wedged for every later config too. A double failure returns an
-    ``{"error": ...}`` record so the completed configs still get recorded.
+    Every attempt runs in a fresh ``python bench.py --model ...`` subprocess
+    under a hard timeout: true isolation is the only reliable containment —
+    an in-process watchdog (SIGALRM) cannot interrupt a tunnel client
+    wedged inside a C-level wait, and a failed remote-TPU call can leave
+    the parent's runtime poisoned for every later config (round 4 lost the
+    entire capture to one mid-suite failure). One retry in a second fresh
+    subprocess; a double failure returns an ``{"error": ...}`` record so
+    the completed configs still get recorded.
     """
-    import signal
-
     # Generous per-config budget: compile (~2-4 min for the long-context
     # configs) + measurement scaled with --steps.
     budget_s = 900 + args.steps * 10
-
-    def _alarm(signum, frame):
-        raise TimeoutError(f"in-process config exceeded {budget_s}s")
-
-    old_handler = signal.signal(signal.SIGALRM, _alarm)
-    signal.alarm(budget_s)
-    try:
-        return run_config(args, model=model, seq_len=seq_len)
-    except Exception as exc:  # noqa: BLE001 — anything mid-config must not kill the suite
-        first_error = f"{type(exc).__name__}: {exc}"
-        sys.stderr.write(
-            f"[bench] {model}@{seq_len} failed in-process ({first_error}); "
-            "retrying in a fresh subprocess\n"
-        )
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old_handler)
     cmd = [
         sys.executable, __file__, "--model", model, "--seq_len", str(seq_len),
         "--steps", str(args.steps), "--warmup", str(args.warmup),
     ]
-    try:
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=budget_s,
+    errors = []
+    for attempt in (1, 2):
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=budget_s,
+            )
+            if proc.returncode == 0:
+                # The single-config path prints exactly one JSON line (last
+                # line of stdout — jax may warn on earlier lines).
+                return json.loads(proc.stdout.strip().splitlines()[-1])
+            errors.append(f"rc={proc.returncode}: {proc.stderr.strip()[-500:]}")
+        except subprocess.TimeoutExpired:
+            errors.append(f"timed out after {budget_s}s")
+        except Exception as exc:  # noqa: BLE001 — nothing may kill the suite
+            errors.append(f"{type(exc).__name__}: {exc}")
+        sys.stderr.write(
+            f"[bench] {model}@{seq_len} attempt {attempt} failed "
+            f"({errors[-1][:200]})\n"
         )
-        if proc.returncode == 0:
-            # The single-config path prints exactly one JSON line (last line
-            # of stdout — jax may warn on earlier lines).
-            return json.loads(proc.stdout.strip().splitlines()[-1])
-        retry_error = f"rc={proc.returncode}: {proc.stderr.strip()[-500:]}"
-    except subprocess.TimeoutExpired:
-        retry_error = f"subprocess retry timed out after {budget_s}s"
-    except Exception as exc:  # noqa: BLE001
-        retry_error = f"{type(exc).__name__}: {exc}"
-    sys.stderr.write(f"[bench] {model}@{seq_len} retry also failed ({retry_error})\n")
     return {
         "metric": "tokens_per_sec_per_chip",
         "value": None,
@@ -226,8 +219,8 @@ def run_config_resilient(args, model: str, seq_len: int) -> dict:
         "vs_baseline": None,
         "model": model,
         "seq_len": seq_len,
-        "error": first_error,
-        "retry_error": retry_error,
+        "error": errors[0],
+        "retry_error": errors[1],
         "versions": dependency_versions(),
     }
 
@@ -253,12 +246,37 @@ def run_config(args, model: str, seq_len: int) -> dict:
     n_chips = jax.device_count()
     on_tpu = jax.devices()[0].platform == "tpu"
     small_model = model in ("124M", "345M")
+    if model == "774M" and not on_tpu:
+        # The suite's 774M row only means something on a TPU: a CPU host
+        # would materialize ~13 GiB of fp32 state+grads to produce a
+        # meaningless number (and swap/OOM CI boxes). Record an explicit
+        # skip instead — counted as an "error" record, so the suite's other
+        # configs still carry the capture.
+        return {
+            "metric": "tokens_per_sec_per_chip",
+            "value": None,
+            "unit": "tok/s/chip",
+            "vs_baseline": None,
+            "model": model,
+            "seq_len": seq_len,
+            "error": "skipped: 774M single-chip row needs a TPU "
+            "(fp32 state+grads ~13 GiB; no meaningful CPU number)",
+            "versions": dependency_versions(),
+        }
+    # 774M on ONE 16G chip is memory-gated by its 9.3 GiB fp32 param+AdamW
+    # state: any grad_accum > 1 adds a 3.1 GiB f32 accumulator carry and
+    # OOMs (round-5 sweep, PRESETS_MEMORY.md), so the operating point is
+    # accum 1 (grads freed leaf-by-leaf into the update) + full-block remat
+    # (mlp/attn sublayer remat both OOM) at micro-batch 8 (b16 fits but
+    # reads 36.5% vs b8's 39.4% MFU). On a pod, FSDP shards the state and
+    # the BASELINE config-4 recipe (b4 a4 remat=mlp) applies instead.
+    single_chip_774m = model == "774M" and n_chips == 1 and on_tpu
     # Round-2 swept operating point on a v5e chip (see PERF_ANALYSIS.md):
     # micro-batch 8, grad-accum 8, NO remat, UNROLLED layers -> 49.2% MFU
     # (113.5k tok/s/chip); the scan/remat defaults only pay off on the
     # larger presets where compile time and activations actually demand them.
     if args.remat is None:
-        remat = False if small_model else "mlp"
+        remat = False if small_model else ("block" if single_chip_774m else "mlp")
     else:
         remat = False if args.remat == "off" else args.remat
     if args.scan_layers == "auto":
@@ -288,15 +306,30 @@ def run_config(args, model: str, seq_len: int) -> dict:
         # 16G chip — and no-remat beats remat=mlp's MLP replay: 51.7% vs
         # 48.1% MFU (round-3 sweep, PERF_ANALYSIS.md §5).
         micro_batch = 6
+    elif single_chip_774m:
+        micro_batch = 8
     else:
         micro_batch = 8 if small_model else 4
     if args.grad_accum_steps:
         grad_accum = args.grad_accum_steps
+    elif single_chip_774m:
+        grad_accum = 1
     elif on_tpu and small_model and seq_len >= 2048:
-        # Swept optima scale accum with seq (b4a16@2048 50.5%, b2a32@4096
-        # 50.7% — vs 50.1/50.0 at a8): bigger optimizer steps amortize the
-        # AdamW update over more tokens as the micro-batch shrinks.
-        grad_accum = 8 * seq_len // 1024
+        # Swept optima scale accum with seq: bigger optimizer steps amortize
+        # the AdamW update over more tokens as the micro-batch shrinks. The
+        # round-5 ladder moved 2048 from a16 to a24 (124M 50.48->50.60%,
+        # 345M 51.10->51.22%); 4096 stays a32 (a48 reads +0.05pp = noise).
+        grad_accum = min(32, 12 * seq_len // 1024)
+    elif on_tpu and model == "345M":
+        # Round-5 accum ladder at b6@1024: a8 52.0%, a12 52.28, a16 52.50,
+        # a24 52.67, a32 52.76 — a16 is the plateau knee (<0.2pp per further
+        # doubling); deeper accum trades optimizer-step granularity for
+        # noise-level gains.
+        grad_accum = 16
+    elif on_tpu and small_model:
+        # 124M@1024 b8: a8 50.2%, a10 50.30, a12 50.43; a16 is the known
+        # scheduling cliff (18%, PERF_ANALYSIS.md) — stop at 12.
+        grad_accum = 12
     else:
         grad_accum = 8 if on_tpu else 1
     seq_len = seq_len if on_tpu else min(seq_len, 256)

@@ -94,10 +94,10 @@ class GPT2Config:
             raise ValueError(
                 f"loss_block_rows={self.loss_block_rows} must be >= 1"
             )
-        if self.remat not in (False, True, "block", "mlp", "dots"):
+        if self.remat not in (False, True, "block", "mlp", "attn", "dots"):
             raise ValueError(
                 f"remat={self.remat!r}: expected False, True, 'block', "
-                f"'mlp' or 'dots'"
+                f"'mlp', 'attn' or 'dots'"
             )
 
     @property

@@ -241,6 +241,15 @@ def _block(
         # memory. Cuts the remat recompute from a full extra forward to the
         # MLP half, and the attention kernel runs once, not twice.
         mlp = jax.checkpoint(_mlp_sublayer, static_argnums=(0, 4))
+    elif config.remat == "attn":
+        # The mirror of "mlp": replay the attention sublayer, save the MLP's
+        # activations. The memory-vs-recompute profile single-chip 774M
+        # wants: attention's per-head internals ([B,H,T,D] stacks — 2x-padded
+        # at D=64 tiling) are what blow 16G HBM, while its replay is only
+        # ~10-15% of layer flops; the MLP's 4C tensors fit once the
+        # attention stacks are gone and its replay (the expensive half)
+        # never runs.
+        attn = jax.checkpoint(_attn_sublayer, static_argnums=(0, 4))
     elif config.remat == "dots":
         # Policy remat: save matmul (dot) outputs, recompute only elementwise
         # ops (LN, GELU, dropout, residuals) in backward. Measured SLOWER
@@ -305,13 +314,13 @@ def hidden_states(
                          deterministic)
             return out, None
 
-        if config.remat and config.remat not in ("mlp", "dots"):
+        if config.remat and config.remat not in ("mlp", "attn", "dots"):
             # Full-block remat ("block"/True); the "mlp" and "dots" policies
             # are applied inside _block itself.
             body = jax.checkpoint(body)
         x, _ = jax.lax.scan(body, x, (block_params, layer_rngs))
     else:
-        full_remat = config.remat and config.remat not in ("mlp", "dots")
+        full_remat = config.remat and config.remat not in ("mlp", "attn", "dots")
         for i in range(config.n_layer):
             bp = jax.tree_util.tree_map(lambda a: a[i], block_params)
             lr = jax.random.fold_in(r_blocks, i) if r_blocks is not None else None

@@ -112,7 +112,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--remat", nargs="?", const="block", default=False,
-        choices=["block", "mlp", "dots"],
+        choices=["block", "mlp", "attn", "dots"],
         help="activation checkpointing: 'block' (full, lowest memory; the "
         "bare flag means this), 'mlp' (remat only the MLP sublayer — "
         "attention runs once; the throughput sweet spot when memory allows) "

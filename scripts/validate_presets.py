@@ -31,6 +31,11 @@ import re
 import sys
 
 HBM_BUDGET_GIB = 15.75  # v5e usable HBM as reported by the XLA TPU compiler
+# Boundary slack: the two byte sums round to 0.01-GiB granularity and the
+# attached chip accepted the 774M b8/a1/block program whose AOT sum reads
+# 15.76 — a row at the budget edge is a "fits" with this slack, and the
+# measured-run caveat below the table is the ground truth.
+FIT_SLACK_GIB = 0.02
 
 # (preset, topology, mesh_data, mesh_fsdp, micro_batch/chip, accum, remat)
 # Parallelism per BASELINE.md configs 3-5; remat choices validated to fit.
@@ -47,6 +52,8 @@ CONFIGS = [
 # regardless of remat/batch (the row below records the compiler saying so),
 # while 774M fits with room that depends on remat x micro-batch.
 CONFIGS_SINGLE_CHIP = [
+    ("774M", "v5e:1x1", 1, 1, 8, 1, "block"),   # measured: 14.9k tok/s, 39.4% MFU
+    ("774M", "v5e:1x1", 1, 1, 16, 1, "block"),  # measured: 13.8k tok/s, 36.5% MFU
     ("774M", "v5e:1x1", 1, 1, 1, 16, "block"),
     ("774M", "v5e:1x1", 1, 1, 1, 16, "mlp"),
     ("774M", "v5e:1x1", 1, 1, 1, 16, False),
@@ -78,7 +85,13 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat):
         make_train_step,
     )
 
-    topo = topologies.get_topology_desc(platform="tpu", topology_name=topo_name)
+    # Pod slices resolve from the name alone; the single-chip case must
+    # override the default 2x2 chips-per-host bounds (tuple form — the
+    # C-API rejects the "1x1x1"/"1,1,1" string spellings).
+    topo_kwargs = {"chips_per_host_bounds": (1, 1, 1)} if topo_name == "v5e:1x1" else {}
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=topo_name, **topo_kwargs
+    )
     n = data * fsdp
     # Canonical 4-axis mesh via the shared helper over the TOPOLOGY's
     # devices (batch_pspec names the 'sp' axis since ring attention landed;
@@ -99,7 +112,11 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat):
         opt_shape, oshard)
     x_in = jax.ShapeDtypeStruct((accum, mb * n, 1024), jnp.int32,
                                 sharding=bshard)
-    step = make_train_step(cfg, opt, donate=False)
+    # donate=True: the production configuration. Round-5 lesson: compiling
+    # donate=False and reporting args+temps silently EXCLUDES the un-aliased
+    # params+opt output buffers (~state-size again) — the donated compile
+    # plus an explicit (output - alias) term is the honest per-chip peak.
+    step = make_train_step(cfg, opt)
     n_params = sum(
         int(np.prod(s.shape)) for s in jtu.tree_leaves(params_shape))
 
@@ -115,12 +132,16 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat):
                 jax.ShapeDtypeStruct((2,), jnp.uint32), 0,
             ).compile()
         ma = compiled.memory_analysis()
-        peak = (ma.argument_size_in_bytes + ma.temp_size_in_bytes) / 2**30
+        out_extra = max(0, ma.output_size_in_bytes - ma.alias_size_in_bytes)
+        peak = (
+            ma.argument_size_in_bytes + ma.temp_size_in_bytes + out_extra
+        ) / 2**30
         row.update(
             argument_gib=round(ma.argument_size_in_bytes / 2**30, 2),
             temp_gib=round(ma.temp_size_in_bytes / 2**30, 2),
+            output_unaliased_gib=round(out_extra / 2**30, 2),
             peak_gib_per_chip=round(peak, 2),
-            fits=bool(peak < HBM_BUDGET_GIB),
+            fits=bool(peak < HBM_BUDGET_GIB + FIT_SLACK_GIB),
         )
     except Exception as e:  # noqa: BLE001 — RESOURCE_EXHAUSTED is a result here
         m = re.search(r"Used ([\d.]+)G of ([\d.]+)G hbm", str(e))
@@ -203,6 +224,22 @@ def main():
                 f"| {r.get('argument_gib', '—')} | {r.get('temp_gib', '—')} "
                 f"| {r['peak_gib_per_chip']} | {'yes' if r['fits'] else 'NO'} |"
             )
+        lines += [
+            "",
+            "Measured on the attached chip (round 5): these donated-compile",
+            "AOT peaks match the chip's own compile verdicts exactly on every",
+            "OOM row (22.77 / 21.37 / 19.48 / 17.42 G observed = the rows",
+            "above) — the structural story is that any grad_accum>1 carries a",
+            "3.1 GiB f32 grad accumulator next to the 9.3 GiB fp32 state and",
+            "cannot fit, while accum 1 lets XLA free each grad leaf into its",
+            "AdamW update. The recorded operating point is **micro-batch 8,",
+            "accum 1, remat=block: 14.9k tok/s/chip, 39.4% MFU** (`python",
+            "bench.py --model 774M`; the suite's 774M@1024 row). Boundary",
+            "rows can diverge between the two compiles: b16/a1/block reads",
+            "18.42G here yet compiles and runs on the chip (memory-pressure",
+            "scheduling), at a slower 36.5% MFU; sublayer remat (mlp/attn)",
+            "OOMs at every accum-1 batch tried (16.6-29.1G).",
+        ]
     with open("PRESETS_MEMORY.md", "w") as f:
         f.write("\n".join(lines) + "\n")
     print("wrote PRESETS_MEMORY.md")

@@ -83,14 +83,34 @@ def test_scan_and_loop_paths_agree(tiny_config, rng_np):
     np.testing.assert_allclose(float(loss1), float(loss2), atol=1e-6)
 
 
-def test_remat_matches_no_remat(tiny_config, rng_np):
+@pytest.mark.parametrize("mode", [True, "mlp", "attn", "dots"])
+def test_remat_matches_no_remat(tiny_config, rng_np, mode):
+    """Every remat mode must be a pure memory/recompute tradeoff: identical
+    loss AND identical gradients to the no-remat graph (the backward pass is
+    where checkpointing actually changes the computation)."""
+    import jax
+
     params = gpt2.init_params(tiny_config)
     x, y = _batch(tiny_config, rng_np, b=2, t=32)
-    _, loss_plain = gpt2.forward(params, tiny_config, x, labels=y,
-                                 compute_dtype=jnp.float32)
-    _, loss_remat = gpt2.forward(params, tiny_config.replace(remat=True), x,
-                                 labels=y, compute_dtype=jnp.float32)
+
+    def loss_of(cfg):
+        def f(p):
+            _, loss = gpt2.forward(p, cfg, x, labels=y, compute_dtype=jnp.float32)
+            return loss
+
+        return jax.value_and_grad(f)(params)
+
+    loss_plain, grad_plain = loss_of(tiny_config)
+    loss_remat, grad_remat = loss_of(tiny_config.replace(remat=mode))
     np.testing.assert_allclose(float(loss_plain), float(loss_remat), rtol=1e-6)
+    for (kp, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(grad_plain),
+        jax.tree_util.tree_leaves_with_path(grad_remat),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+            err_msg=f"grad mismatch at {kp} under remat={mode}",
+        )
 
 
 def test_ignore_index_masking(tiny_config, rng_np):
