@@ -120,10 +120,17 @@ class ShardWriter:
         out_dir: str,
         dataset_name: str = "fineweb",
         shard_size: int = SHARD_SIZE,
+        encoding: str = "gpt2",
     ) -> None:
         os.makedirs(out_dir, exist_ok=True)
         self.out_dir = out_dir
         self.dataset_name = dataset_name
+        # Recorded in metadata.json; the byte codec must not masquerade as
+        # a BPE in the on-disk record ("byte" is the only offline codec —
+        # every other encoding name resolves through tiktoken).
+        self.tokenizer_label = (
+            "offline-byte-codec" if encoding == "byte" else f"tiktoken:{encoding}"
+        )
         self.shard_size = int(shard_size)
         self.buffer = np.empty(self.shard_size, dtype=np.uint16)
         self.fill = 0
@@ -161,7 +168,7 @@ class ShardWriter:
             self._flush(self.fill)
         meta = {
             "dataset": self.dataset_name,
-            "tokenizer": "tiktoken:gpt2",
+            "tokenizer": self.tokenizer_label,
             "dtype": "<u2",
             "eot_prepended": True,
             "shard_size": self.shard_size,
@@ -184,7 +191,7 @@ def tokenize_corpus(
 ) -> dict:
     """Tokenize an iterable of ``{"text": ...}`` rows into shards. Returns the
     metadata dict. Multiprocess pool with ``imap`` mirrors notebook cell 13."""
-    writer = ShardWriter(out_dir, dataset_name, shard_size)
+    writer = ShardWriter(out_dir, dataset_name, shard_size, encoding=encoding)
     if num_procs is None:
         num_procs = max(1, (os.cpu_count() or 2) - 1)
     if num_procs > 1:

@@ -226,7 +226,11 @@ def main():
             )
         lines += [
             "",
-            "Measured on the attached chip (round 5): these donated-compile",
+            "Measured on the attached chip (ROUND-5 RECORD — a dated",
+            "measurement note this generator reprints verbatim, not a claim",
+            "it re-verifies; canonical copy + context in PERF_ANALYSIS.md",
+            "§10, re-measure before trusting after kernel or remat",
+            "changes): these donated-compile",
             "AOT peaks match the chip's own compile verdicts exactly on every",
             "OOM row (22.77 / 21.37 / 19.48 / 17.42 G observed = the rows",
             "above) — the structural story is that any grad_accum>1 carries a",
