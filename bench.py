@@ -97,6 +97,14 @@ def main() -> None:
         "'block' for single-chip 774M, 'mlp' for other large presets)",
     )
     p.add_argument(
+        "--accum_dtype", default="auto", choices=["auto", "fp32", "bf16"],
+        help="gradient-accumulator carry dtype. bf16 halves the carry "
+        "(1.55 vs 3.1 GiB at 774M — the knob that admits accum>1 on one "
+        "16G chip; 42.6%% vs 39.4%% MFU) and mirrors the reference FSDP's "
+        "bf16 grad reduction; fp32 is torch-autocast parity. 'auto' = "
+        "bf16 for single-chip 774M, fp32 everywhere else",
+    )
+    p.add_argument(
         "--unroll_accum", action="store_true",
         help="unroll the grad-accumulation loop instead of lax.scan "
         "(measured WORSE at 124M — memory pressure beats the cross-micro "
@@ -132,6 +140,7 @@ def main() -> None:
                 ("--remat", args.remat is not None),
                 ("--scan_layers", args.scan_layers != "auto"),
                 ("--unroll_accum", args.unroll_accum),
+                ("--accum_dtype", args.accum_dtype != "auto"),
                 ("--loss_block_rows", args.loss_block_rows),
             ) if hit
         ]
@@ -264,12 +273,15 @@ def run_config(args, model: str, seq_len: int) -> dict:
             "versions": dependency_versions(),
         }
     # 774M on ONE 16G chip is memory-gated by its 9.3 GiB fp32 param+AdamW
-    # state: any grad_accum > 1 adds a 3.1 GiB f32 accumulator carry and
-    # OOMs (round-5 sweep, PRESETS_MEMORY.md), so the operating point is
-    # accum 1 (grads freed leaf-by-leaf into the update) + full-block remat
-    # (mlp/attn sublayer remat both OOM) at micro-batch 8 (b16 fits but
-    # reads 36.5% vs b8's 39.4% MFU). On a pod, FSDP shards the state and
-    # the BASELINE config-4 recipe (b4 a4 remat=mlp) applies instead.
+    # state: an fp32 grad-accumulator carry adds 3.1 GiB and OOMs at any
+    # accum > 1 (round-5 sweep, PRESETS_MEMORY.md). The operating point is
+    # full-block remat (mlp/attn sublayer remat both OOM) at micro-batch 8
+    # with a BF16 accumulator carry (1.55 GiB — fits) at accum 8: 42.6%
+    # MFU vs 39.4% for the fp32-carry accum-1 fallback (`--accum_dtype
+    # fp32` records that torch-autocast-parity point). bf16 grad summation
+    # has reference precedent: its FSDP reduces grads in bf16
+    # (MixedPrecision, train_gpt2_distributed.py:151-155). On a pod, FSDP
+    # shards the state and the BASELINE config-4 recipe applies instead.
     single_chip_774m = model == "774M" and n_chips == 1 and on_tpu
     # Round-2 swept operating point on a v5e chip (see PERF_ANALYSIS.md):
     # micro-batch 8, grad-accum 8, NO remat, UNROLLED layers -> 49.2% MFU
@@ -313,7 +325,7 @@ def run_config(args, model: str, seq_len: int) -> dict:
     if args.grad_accum_steps:
         grad_accum = args.grad_accum_steps
     elif single_chip_774m:
-        grad_accum = 1
+        grad_accum = 1 if args.accum_dtype == "fp32" else 8
     elif on_tpu and small_model and seq_len >= 2048:
         # Swept optima scale accum with seq: bigger optimizer steps amortize
         # the AdamW update over more tokens as the micro-batch shrinks. The
@@ -347,7 +359,13 @@ def run_config(args, model: str, seq_len: int) -> dict:
 
     with activate_mesh(mesh):
         params, opt_state, _, _ = shard_params_and_opt_state(params, optimizer, mesh)
-        step = make_train_step(config, optimizer, unroll_accum=args.unroll_accum)
+        accum_bf16 = args.accum_dtype == "bf16" or (
+            args.accum_dtype == "auto" and single_chip_774m
+        )
+        step = make_train_step(
+            config, optimizer, unroll_accum=args.unroll_accum,
+            accum_dtype=jnp.bfloat16 if accum_bf16 else None,
+        )
         x, y = shard_batch((x, y), mesh)
         key = jax.random.PRNGKey(0)
 
@@ -383,6 +401,7 @@ def run_config(args, model: str, seq_len: int) -> dict:
         "seq_len": seq_len,
         "micro_batch_per_chip": micro_batch,
         "grad_accum": grad_accum,
+        "accum_dtype": "bf16" if accum_bf16 else "fp32",
         "n_chips": n_chips,
         "device": jax.devices()[0].device_kind,
         "flops_per_token": flops_per_token(config, seq_len),

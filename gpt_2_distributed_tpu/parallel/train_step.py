@@ -68,6 +68,7 @@ def make_train_step(
     compute_dtype: jnp.dtype = jnp.bfloat16,
     donate: bool = True,
     unroll_accum: bool = False,
+    accum_dtype: jnp.dtype | None = None,
 ) -> Callable:
     """Build the jitted train step.
 
@@ -85,6 +86,19 @@ def make_train_step(
     global automatically (XLA inserts the psum), params sharded over 'fsdp'
     makes this the ZeRO-3 schedule. Params and opt_state buffers are donated —
     the update is in-place in HBM, like the reference's fused optimizer.
+
+    ``accum_dtype`` sets the cross-micro-batch gradient accumulator's dtype
+    (None = the params' fp32 — torch-autocast parity, where ``.grad`` stays
+    fp32). ``jnp.bfloat16`` halves the accumulator carry — the knob that
+    gives single-chip 774M any accum > 1 at all (the fp32 carry alone is
+    3.1 GiB, PRESETS_MEMORY.md) — and has reference precedent: torch FSDP
+    there SUMS gradients in bf16 across ranks
+    (``MixedPrecision(reduce_dtype=bf16)``,
+    ``/root/reference/train_gpt2_distributed.py:151-155``); this applies
+    the same rounding across micro-steps instead. Opt-in (CLI/bench
+    ``--accum_dtype bf16``): expect ~1e-2-relative gradient rounding; the
+    AdamW update itself still runs on fp32 (the carry is upcast before
+    ``optimizer.update``).
     """
 
     def train_step(params, opt_state, x, y, rng, step_idx):
@@ -114,7 +128,9 @@ def make_train_step(
             xb, yb, i = inp
             micro_rng = jax.random.fold_in(step_rng, i)
             loss, grads = grad_fn(params, xb, yb, micro_rng)
-            grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(a.dtype), grad_acc, grads
+            )
             return (grad_acc, loss_acc + loss), None
 
         # The accumulator seeds with a zeros tree rather than peeling
@@ -122,7 +138,9 @@ def make_train_step(
         # whole-step at 124M b8a8 on v5e — duplicating the micro-step HLO
         # outside the scan costs more in scheduling than the skipped
         # zeros-init round-trip saves.
-        zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        zero_grads = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, accum_dtype or p.dtype), params
+        )
         carry = (zero_grads, jnp.zeros((), jnp.float32))
         if unroll_accum:
             # Unrolled micro-batch loop: XLA can overlap micro-batch i's
@@ -137,6 +155,11 @@ def make_train_step(
                 micro_step, carry, (x, y, jnp.arange(accum)),
             )
         grads, loss = carry
+        # Upcast a reduced-precision carry before the norm and the AdamW
+        # math — the rounding happened in accumulation; the update is fp32.
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), grads, params
+        )
         grad_norm = optax.global_norm(grads)
 
         updates, new_opt_state = optimizer.update(grads, opt_state, params)

@@ -120,6 +120,14 @@ def build_parser() -> argparse.ArgumentParser:
         "elementwise ops — measured slower than both at 124M, situational)",
     )
     p.add_argument(
+        "--accum_dtype", default="fp32", choices=["fp32", "bf16"],
+        help="gradient-accumulator carry dtype: fp32 (torch-autocast "
+        "parity, default) or bf16 (halves the carry — the knob that admits "
+        "accum>1 for 774M on one 16G chip; mirrors the reference FSDP's "
+        "bf16 gradient reduction, "
+        "/root/reference/train_gpt2_distributed.py:151-155)",
+    )
+    p.add_argument(
         "--loss_impl", default="blocked", choices=["blocked", "dense"],
         help="training loss: 'blocked' logit-free chunked CE (O(rows*V) HBM) "
         "or 'dense' full-logits XLA autodiff (only viable at small "
@@ -325,7 +333,12 @@ def main(argv: list[str] | None = None) -> None:
         params, opt_state, param_shardings, opt_shardings = (
             shard_params_and_opt_state(params, optimizer, mesh)
         )
-        train_step = make_train_step(config, optimizer)
+        import jax.numpy as jnp
+
+        train_step = make_train_step(
+            config, optimizer,
+            accum_dtype=jnp.bfloat16 if args.accum_dtype == "bf16" else None,
+        )
 
         # --- resume ---------------------------------------------------------
         start_epoch, skip_steps, global_step, total_tokens = 0, 0, 0, 0
