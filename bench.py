@@ -156,6 +156,7 @@ def main() -> None:
         records = []
         for model, seq_len in SUITE_CONFIGS:
             records.append(run_config_resilient(args, model=model, seq_len=seq_len))
+            _write_self_record({"partial": True, "suite": records})
         # The first successful record is the headline (drivers read the
         # top-level metric); the full sweep rides along under "suite".
         # Compare on the REQUESTED config, not record fields — off-TPU runs
@@ -173,6 +174,7 @@ def main() -> None:
             head["headline_fallback"] = True
         head["suite"] = records
         print(json.dumps(head))
+        _write_self_record(head)
         if not ok:
             sys.exit(1)
     else:
@@ -181,6 +183,32 @@ def main() -> None:
             model=args.model or "124M",
             seq_len=args.seq_len or 1024,
         )))
+
+
+import os
+
+# Anchored to the repo (next to this file), not the caller's cwd — the
+# post-mortem after a mid-suite kill looks here.
+SELF_RECORD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_SELF.json"
+)
+
+
+def _write_self_record(payload: dict) -> None:
+    """Persist suite progress (and the final result) atomically.
+
+    The driver captures the ONE stdout line printed at the very end; if its
+    window expires mid-suite, that capture is empty no matter how resilient
+    the per-config attempts were. This file is the self-recorded fallback:
+    always the latest completed records, tmp-file + os.replace so a kill at
+    any instant leaves the previous complete snapshot intact."""
+    tmp = SELF_RECORD_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, SELF_RECORD_PATH)
+    except OSError as exc:  # read-only checkout etc. — never block the run
+        sys.stderr.write(f"[bench] could not write {SELF_RECORD_PATH}: {exc}\n")
 
 
 def run_config_resilient(args, model: str, seq_len: int) -> dict:
