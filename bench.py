@@ -232,21 +232,53 @@ def run_config_resilient(args, model: str, seq_len: int) -> dict:
         sys.executable, __file__, "--model", model, "--seq_len", str(seq_len),
         "--steps", str(args.steps), "--warmup", str(args.warmup),
     ]
+    # Forward every operating-point flag the parent was given, so the child
+    # subprocess benches the SAME configuration — the invariant lives here,
+    # next to the cmd, instead of relying on suite mode rejecting overrides
+    # at parse time. getattr defaults: callers (tests) may drive this with a
+    # minimal Namespace; absent attributes mean "at default, don't forward".
+    if getattr(args, "batch", 0):
+        cmd += ["--batch", str(args.batch)]
+    if getattr(args, "grad_accum_steps", 0):
+        cmd += ["--grad_accum_steps", str(args.grad_accum_steps)]
+    if getattr(args, "remat", None) is not None:
+        cmd += ["--remat", args.remat]
+    if getattr(args, "accum_dtype", "auto") != "auto":
+        cmd += ["--accum_dtype", args.accum_dtype]
+    if getattr(args, "unroll_accum", False):
+        cmd += ["--unroll_accum"]
+    if getattr(args, "loss_block_rows", 0):
+        cmd += ["--loss_block_rows", str(args.loss_block_rows)]
+    if getattr(args, "scan_layers", "auto") != "auto":
+        cmd += ["--scan_layers", args.scan_layers]
     errors = []
     for attempt in (1, 2):
         try:
             proc = subprocess.run(
                 cmd, capture_output=True, text=True, timeout=budget_s,
             )
-            if proc.returncode == 0:
-                # The single-config path prints exactly one JSON line (last
-                # line of stdout — jax may warn on earlier lines).
-                return json.loads(proc.stdout.strip().splitlines()[-1])
-            errors.append(f"rc={proc.returncode}: {proc.stderr.strip()[-500:]}")
         except subprocess.TimeoutExpired:
             errors.append(f"timed out after {budget_s}s")
-        except Exception as exc:  # noqa: BLE001 — nothing may kill the suite
+        except OSError as exc:  # spawn failure (ENOMEM, missing interpreter)
             errors.append(f"{type(exc).__name__}: {exc}")
+        else:
+            if proc.returncode == 0:
+                try:
+                    # The single-config path prints exactly one JSON line
+                    # (last line of stdout — jax may warn on earlier lines).
+                    return json.loads(proc.stdout.strip().splitlines()[-1])
+                except (json.JSONDecodeError, IndexError) as exc:
+                    # rc=0 but no parseable JSON line is a protocol bug in
+                    # the child, not a child failure — label it distinctly.
+                    errors.append(
+                        f"parse failure (child rc=0): "
+                        f"{type(exc).__name__}: {exc}; stdout tail: "
+                        f"{proc.stdout.strip()[-200:]!r}"
+                    )
+            else:
+                errors.append(
+                    f"rc={proc.returncode}: {proc.stderr.strip()[-500:]}"
+                )
         sys.stderr.write(
             f"[bench] {model}@{seq_len} attempt {attempt} failed "
             f"({errors[-1][:200]})\n"

@@ -34,6 +34,8 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from gpt_2_distributed_tpu import resilience
+
 STEP_DIR_RE = re.compile(r"^step_(\d{7,})$")
 
 
@@ -80,6 +82,11 @@ def save_checkpoint(
     if jax.process_index() == 0:
         with open(os.path.join(path, "meta.json"), "w") as f:
             f.write(meta.to_json())
+        # manifest.json is the atomic commit point (tmp + fsync + rename):
+        # it records sizes + CRC32C over everything above, so a checkpoint
+        # without a valid manifest is either legacy (pre-manifest) or was
+        # interrupted mid-save — restore_latest_verified tells them apart.
+        resilience.write_manifest(path, step)
     return path
 
 
@@ -99,6 +106,53 @@ def list_checkpoints(save_dir: str) -> list[tuple[int, str]]:
 def latest_checkpoint(save_dir: str) -> str | None:
     ckpts = list_checkpoints(save_dir)
     return ckpts[-1][1] if ckpts else None
+
+
+def restore_latest_verified(
+    save_dir: str,
+    params_template: Any,
+    opt_state_template: Any,
+    param_shardings: Any | None = None,
+    opt_state_shardings: Any | None = None,
+) -> tuple[Any, Any, CheckpointMeta, str] | None:
+    """Restore the newest checkpoint that passes integrity verification,
+    falling back step-by-step past truncated/corrupt ones.
+
+    Walks ``list_checkpoints`` newest -> oldest; each candidate must pass
+    ``resilience.verify_checkpoint`` (manifest sizes + CRC32C when a manifest
+    exists, structural checks for legacy pre-manifest dirs) before the orbax
+    restore is even attempted, and a restore that still blows up (e.g. a
+    corrupt OCDBT record behind an intact manifest written by an older code
+    version) also falls through to the next candidate. Every discard is
+    logged on process 0. Returns ``(params, opt_state, meta, path)``, or
+    None when no checkpoint survives.
+    """
+    candidates = list(reversed(list_checkpoints(save_dir)))
+    for i, (step, path) in enumerate(candidates):
+        problems = resilience.verify_checkpoint(path)
+        if problems:
+            if jax.process_index() == 0:
+                print(
+                    f"[resilience] discarding corrupt checkpoint {path}: "
+                    + "; ".join(problems)
+                )
+            continue
+        try:
+            params, opt_state, meta = restore_checkpoint(
+                path, params_template, opt_state_template,
+                param_shardings, opt_state_shardings,
+            )
+        except Exception as exc:  # orbax raises a zoo of error types
+            if i == len(candidates) - 1:
+                raise  # oldest candidate: nothing left to fall back to
+            if jax.process_index() == 0:
+                print(
+                    f"[resilience] discarding unreadable checkpoint {path}: "
+                    f"{type(exc).__name__}: {exc}"
+                )
+            continue
+        return params, opt_state, meta, path
+    return None
 
 
 def _as_abstract(tree: Any, shardings: Any | None) -> Any:

@@ -58,6 +58,20 @@ METRIC_REGISTRY.metric(
     "batch", reduction=ReductionStrategy.CURRENT, cli_format="batch: {value:.0f}",
 )(lambda v: float(int(v)))
 
+# Resilience (train.py --step_guard): cumulative count of optimizer steps the
+# non-finite guard skipped, and the SKIP_* reason code of the latest skip
+# (resilience.SKIP_REASON_NAMES; 0 = never skipped). skipped_steps shows on
+# the CLI line only once a skip happened (a steady "skipped: 0" would be
+# noise); the reason code is TB-only.
+METRIC_REGISTRY.metric(
+    "skipped_steps", reduction=ReductionStrategy.CURRENT,
+    cli_format="skipped: {value:.0f}",
+)(lambda v: float(int(v)))
+
+METRIC_REGISTRY.metric(
+    "last_skip_reason", reduction=ReductionStrategy.CURRENT, cli_format=None,
+)(lambda v: float(int(v)))
+
 # Periodic validation loss over the held-out shard (shard 0 is reserved as
 # "val" by the tokenizer pipeline, notebook cell 13 convention). The reference
 # reserves the split but never consumes it; the TPU build's --eval_every wires

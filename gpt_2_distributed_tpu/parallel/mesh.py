@@ -210,6 +210,10 @@ def active_mesh() -> Mesh | None:
         # get_mesh() refuses to run under an active jit trace; inside a trace
         # only the explicit activate_mesh registry (checked above) applies.
         return None
+    except AttributeError:
+        # jax.sharding.get_mesh() is not present in every supported JAX
+        # version; without it the set_mesh idiom can't be in effect.
+        return None
     return None if getattr(m, "empty", True) else m
 
 

@@ -62,6 +62,15 @@ class StepMetrics(NamedTuple):
     grad_norm: jnp.ndarray  # scalar fp32, global L2 norm of the accumulated grad
 
 
+class GuardedStepMetrics(NamedTuple):
+    """StepMetrics plus the anomaly-guard telemetry (guard=True steps)."""
+
+    loss: jnp.ndarray
+    grad_norm: jnp.ndarray
+    skipped_steps: jnp.ndarray  # int32, cumulative updates skipped (post-step)
+    skip_reason: jnp.ndarray    # int32 SKIP_* code for THIS step; 0 = applied
+
+
 def make_train_step(
     config: GPT2Config,
     optimizer: optax.GradientTransformation,
@@ -69,6 +78,7 @@ def make_train_step(
     donate: bool = True,
     unroll_accum: bool = False,
     accum_dtype: jnp.dtype | None = None,
+    guard: bool = False,
 ) -> Callable:
     """Build the jitted train step.
 
@@ -91,17 +101,38 @@ def make_train_step(
     (None = the params' fp32 — torch-autocast parity, where ``.grad`` stays
     fp32). ``jnp.bfloat16`` halves the accumulator carry — the knob that
     gives single-chip 774M any accum > 1 at all (the fp32 carry alone is
-    3.1 GiB, PRESETS_MEMORY.md) — and has reference precedent: torch FSDP
-    there SUMS gradients in bf16 across ranks
-    (``MixedPrecision(reduce_dtype=bf16)``,
-    ``/root/reference/train_gpt2_distributed.py:151-155``); this applies
-    the same rounding across micro-steps instead. Opt-in (CLI/bench
-    ``--accum_dtype bf16``): expect ~1e-2-relative gradient rounding; the
-    AdamW update itself still runs on fp32 (the carry is upcast before
+    3.1 GiB, PRESETS_MEMORY.md) — similar in spirit to (not the same
+    rounding as) the reference FSDP's bf16 gradient handling: torch's
+    ``MixedPrecision(reduce_dtype=bf16)``
+    (``/root/reference/train_gpt2_distributed.py:151-155``) is a ONE-SHOT
+    cross-rank reduction of each backward's grads, whereas this carry is a
+    *sequential running bf16 sum* over up to ``accum`` micro-steps of
+    1/accum-scaled grads — later addends lose low-order bits against a
+    growing carry, so the rounding compounds with depth (and accum counts
+    deeper than the measured 8 widen the bound further). Opt-in (CLI/bench
+    ``--accum_dtype bf16``): expect ~1e-2-relative gradient rounding
+    (pinned by ``test_bf16_accum_tracks_fp32_accum``); the AdamW update
+    itself still runs on fp32 (the carry is upcast before
     ``optimizer.update``).
+
+    ``guard=True`` builds the resilient production step (``resilience.py``
+    layer 1): signature becomes ::
+
+        new_params, new_opt_state, new_guard_state, metrics = step(
+            params, opt_state, guard_state, x, y, rng, step_idx, loss_scale)
+
+    where ``guard_state`` is a :class:`resilience.GuardState` and
+    ``loss_scale`` is a ``[grad_accum]`` fp32 vector multiplied into each
+    micro-batch's loss (all-ones in production; ``--inject_nan_at`` poisons
+    one entry to fault-inject a non-finite step). The optimizer update is
+    ``lax.cond``-gated on ``isfinite(loss) & isfinite(grad_norm)``: a
+    non-finite step returns params/opt-state *bit-unchanged* (identity
+    update), bumps ``skipped_steps`` and records the SKIP_* reason code —
+    both also mirrored into :class:`GuardedStepMetrics` so the host can read
+    them with the usual one-step lag without touching the donated state.
     """
 
-    def train_step(params, opt_state, x, y, rng, step_idx):
+    def accumulate_grads(params, x, y, rng, step_idx, loss_scale=None):
         step_rng = jax.random.fold_in(rng, step_idx)
         accum = x.shape[0]
 
@@ -114,20 +145,28 @@ def make_train_step(
         # for free.
         inv_accum = 1.0 / accum
 
-        def loss_fn(params, x, y, rng):
+        def loss_fn(params, x, y, rng, scale):
             _, loss = gpt2.forward(
                 params, config, x, labels=y,
                 rng=rng, deterministic=False, compute_dtype=compute_dtype,
             )
+            if scale is not None:
+                # Guard-mode fault-injection hook: all-ones in production, so
+                # the multiply is a no-op the guard pays for its testability.
+                loss = loss * scale
             return loss * inv_accum
 
         grad_fn = jax.value_and_grad(loss_fn)
 
         def micro_step(carry, inp):
             grad_acc, loss_acc = carry
-            xb, yb, i = inp
+            if loss_scale is None:
+                xb, yb, i = inp
+                scale = None
+            else:
+                xb, yb, i, scale = inp
             micro_rng = jax.random.fold_in(step_rng, i)
-            loss, grads = grad_fn(params, xb, yb, micro_rng)
+            loss, grads = grad_fn(params, xb, yb, micro_rng, scale)
             grad_acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(a.dtype), grad_acc, grads
             )
@@ -149,11 +188,15 @@ def make_train_step(
             # (PERF_ANALYSIS.md §3). HLO grows linearly in accum; use for
             # small accum counts on the perf path.
             for i in range(accum):
-                carry, _ = micro_step(carry, (x[i], y[i], jnp.asarray(i)))
+                inp = (x[i], y[i], jnp.asarray(i))
+                if loss_scale is not None:
+                    inp += (loss_scale[i],)
+                carry, _ = micro_step(carry, inp)
         else:
-            carry, _ = jax.lax.scan(
-                micro_step, carry, (x, y, jnp.arange(accum)),
-            )
+            xs = (x, y, jnp.arange(accum))
+            if loss_scale is not None:
+                xs += (loss_scale,)
+            carry, _ = jax.lax.scan(micro_step, carry, xs)
         grads, loss = carry
         # Upcast a reduced-precision carry before the norm and the AdamW
         # math — the rounding happened in accumulation; the update is fp32.
@@ -161,12 +204,77 @@ def make_train_step(
             lambda g, p: g.astype(p.dtype), grads, params
         )
         grad_norm = optax.global_norm(grads)
+        return grads, loss, grad_norm
 
-        updates, new_opt_state = optimizer.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt_state, StepMetrics(loss=loss, grad_norm=grad_norm)
+    if not guard:
 
-    return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+        def train_step(params, opt_state, x, y, rng, step_idx):
+            grads, loss, grad_norm = accumulate_grads(params, x, y, rng, step_idx)
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt_state, StepMetrics(
+                loss=loss, grad_norm=grad_norm
+            )
+
+        return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+
+    from gpt_2_distributed_tpu.resilience import (
+        GuardState,
+        SKIP_NONFINITE_GRAD,
+        SKIP_NONFINITE_LOSS,
+    )
+
+    def guarded_train_step(
+        params, opt_state, guard_state, x, y, rng, step_idx, loss_scale
+    ):
+        grads, loss, grad_norm = accumulate_grads(
+            params, x, y, rng, step_idx, loss_scale
+        )
+        loss_ok = jnp.isfinite(loss)
+        ok = jnp.logical_and(loss_ok, jnp.isfinite(grad_norm))
+
+        def apply_update(_):
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt_state
+
+        def identity_update(_):
+            # Skipped step: params AND opt-state bit-unchanged — optax's
+            # internal step count does not advance either, so the skipped
+            # step is invisible to moment bias-correction and schedules.
+            return params, opt_state
+
+        new_params, new_opt_state = jax.lax.cond(
+            ok, apply_update, identity_update, operand=None
+        )
+        # A non-finite grad_norm under a finite loss (0*inf in the backward)
+        # is distinguished from a non-finite loss itself.
+        reason = jnp.where(
+            ok,
+            0,
+            jnp.where(loss_ok, SKIP_NONFINITE_GRAD, SKIP_NONFINITE_LOSS),
+        ).astype(jnp.int32)
+        new_guard = GuardState(
+            skipped_steps=(
+                guard_state.skipped_steps + (1 - ok.astype(jnp.int32))
+            ),
+            last_skip_reason=jnp.where(
+                ok, guard_state.last_skip_reason, reason
+            ).astype(jnp.int32),
+        )
+        # Counters are duplicated into the metrics: guard_state is donated
+        # into the NEXT step before the host reads metrics (one-step lag), so
+        # the metrics copy is the only safely-readable one.
+        metrics = GuardedStepMetrics(
+            loss=loss,
+            grad_norm=grad_norm,
+            skipped_steps=new_guard.skipped_steps,
+            skip_reason=reason,
+        )
+        return new_params, new_opt_state, new_guard, metrics
+
+    return jax.jit(
+        guarded_train_step, donate_argnums=(0, 1, 2) if donate else ()
+    )
 
 
 def make_eval_step(

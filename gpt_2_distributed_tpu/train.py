@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 from typing import Any
 
 import numpy as np
@@ -40,6 +41,28 @@ from gpt_2_distributed_tpu.data.dataloader import (
 )
 
 DEFAULT_SEED = 42  # reference global seed, /root/reference/train_gpt2_distributed.py:39
+
+
+def _claim_one_shot(save_dir: str | None, name: str, fired: set) -> bool:
+    """True exactly once per (resumable) run for a named fault injection.
+
+    Marker file in ``save_dir`` when given — it survives supervised
+    relaunches, so an injection fires once across the whole supervise
+    lifecycle (the ``--inject_fail_at`` pattern) — otherwise an in-process
+    set, good enough for single-invocation tests without a save dir.
+    """
+    if save_dir:
+        os.makedirs(save_dir, exist_ok=True)
+        marker = os.path.join(save_dir, f".{name}")
+        if os.path.exists(marker):
+            return False
+        with open(marker, "w") as f:
+            f.write("1")
+        return True
+    if name in fired:
+        return False
+    fired.add(name)
+    return True
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -109,6 +132,45 @@ def build_parser() -> argparse.ArgumentParser:
         "step N completes. One-shot via a marker file in --save_dir, so a "
         "supervised relaunch (scripts/supervise.sh) proves resume-after-"
         "crash end-to-end. 0 = off; requires --save_dir.",
+    )
+    p.add_argument(
+        "--step_guard", default="on", choices=["on", "off"],
+        help="non-finite step guard (resilience layer 1): lax.cond-gate the "
+        "optimizer update on isfinite(loss) & isfinite(grad_norm) — a bad "
+        "step applies the identity update (params/opt-state unchanged) and "
+        "is counted in the skipped_steps metric; 'off' restores the "
+        "unguarded step exactly",
+    )
+    p.add_argument(
+        "--spike_sigma", type=float, default=6.0,
+        help="loss-spike threshold in EMA standard deviations (one-sided, "
+        "upward); spiking and guard-skipped steps count toward the "
+        "rollback policy",
+    )
+    p.add_argument(
+        "--max_consecutive_skips", type=int, default=3,
+        help="after this many consecutive skipped/spiking steps, restore "
+        "the last verified checkpoint and fast-forward the dataloader "
+        "past the offending batches",
+    )
+    p.add_argument(
+        "--max_rollbacks", type=int, default=3,
+        help="abort the run after this many spike rollbacks (a loss that "
+        "keeps diverging needs a human, not a loop)",
+    )
+    p.add_argument(
+        "--inject_nan_at", type=int, default=0,
+        help="fault injection: poison one micro-batch's loss with NaN on "
+        "the optimizer step that would complete as step N (one-shot via a "
+        "marker file when --save_dir is set, so supervised relaunches "
+        "don't re-fire). Requires --step_guard on. 0 = off.",
+    )
+    p.add_argument(
+        "--inject_preempt_at", type=int, default=0,
+        help="fault injection: SIGTERM this process after optimizer step N "
+        "completes (one-shot marker in --save_dir), exercising the "
+        "preemption handler end-to-end: emergency save, exit rc 143, "
+        "supervised resume. 0 = off; requires --save_dir.",
     )
     p.add_argument(
         "--remat", nargs="?", const="block", default=False,
@@ -227,6 +289,10 @@ def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
     if args.inject_fail_at and not args.save_dir:
         build_parser().error("--inject_fail_at needs --save_dir (one-shot marker + resume target)")
+    if args.inject_preempt_at and not args.save_dir:
+        build_parser().error("--inject_preempt_at needs --save_dir (one-shot marker + resume target)")
+    if args.inject_nan_at and args.step_guard != "on":
+        build_parser().error("--inject_nan_at requires --step_guard on (an unguarded NaN update poisons the params permanently)")
 
     # Honor --device (highest priority) then JAX_PLATFORMS, even when a site
     # boot hook force-registered a different backend before us (observed: an
@@ -252,6 +318,13 @@ def main(argv: list[str] | None = None) -> None:
     import jax
 
     from gpt_2_distributed_tpu import checkpoint as ckpt
+    from gpt_2_distributed_tpu.resilience import (
+        PREEMPTED_EXIT_CODE,
+        SKIP_REASON_NAMES,
+        PreemptionHandler,
+        SpikeMonitor,
+        init_guard_state,
+    )
     from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
     from gpt_2_distributed_tpu.models import gpt2
     from gpt_2_distributed_tpu.parallel.sharding import (
@@ -335,19 +408,35 @@ def main(argv: list[str] | None = None) -> None:
         )
         import jax.numpy as jnp
 
+        use_guard = args.step_guard == "on"
         train_step = make_train_step(
             config, optimizer,
             accum_dtype=jnp.bfloat16 if args.accum_dtype == "bf16" else None,
+            guard=use_guard,
         )
+        guard_state = init_guard_state() if use_guard else None
+        monitor = (
+            SpikeMonitor(
+                sigma=args.spike_sigma,
+                max_consecutive=args.max_consecutive_skips,
+            )
+            if use_guard else None
+        )
+        # loss_scale is all-ones in production; --inject_nan_at swaps in
+        # nan_scale for one step (same shape/dtype, so no retrace).
+        ones_scale = (
+            jnp.ones((args.grad_accum_steps,), jnp.float32) if use_guard else None
+        )
+        nan_scale = ones_scale.at[0].set(jnp.nan) if use_guard else None
 
         # --- resume ---------------------------------------------------------
         start_epoch, skip_steps, global_step, total_tokens = 0, 0, 0, 0
         if args.resume and args.save_dir:
-            latest = ckpt.latest_checkpoint(args.save_dir)
-            if latest is not None:
-                params, opt_state, meta = ckpt.restore_checkpoint(
-                    latest, params, opt_state, param_shardings, opt_shardings
-                )
+            restored = ckpt.restore_latest_verified(
+                args.save_dir, params, opt_state, param_shardings, opt_shardings
+            )
+            if restored is not None:
+                params, opt_state, meta, latest = restored
                 start_epoch = meta.epoch
                 skip_steps = meta.batches_in_epoch
                 global_step = meta.step
@@ -448,122 +537,294 @@ def main(argv: list[str] | None = None) -> None:
         rng = jax.random.PRNGKey(args.seed)
         lr_of = schedule if callable(schedule) else (lambda _s: args.lr)
 
+        # Preemption contract (resilience layer 4): SIGTERM only sets a flag;
+        # the loop checks it at each optimizer-step boundary, saves one
+        # emergency checkpoint, and exits rc 143 for a supervised --resume.
+        preempt = PreemptionHandler().install()
+
         # --- epoch/step loop --------------------------------------------------
         # Metrics are consumed with a one-step lag: step N+1 is dispatched
         # (async) before step N's loss is read back, so the host->device
         # pipeline never drains on the device-to-host sync — the reference
         # pays that sync every step via loss.item(). The logged step index is
-        # exact; only the wall-clock moment of logging shifts.
+        # exact; only the wall-clock moment of logging shifts. The same lag
+        # applies to the guard/spike bookkeeping below: a skip is noticed one
+        # step later, which the rollback policy absorbs (its data cursor
+        # already sits past the offending batches).
         pending: tuple[int, int, int, Any] | None = None
+        rollback_requested = False
+        last_skip_reason_host = 0
 
         def flush_pending() -> None:
-            nonlocal pending
+            nonlocal pending, rollback_requested, last_skip_reason_host
             if pending is None:
                 return
             p_step, p_epoch, p_batch, p_m = pending
             pending = None
+            extra = {}
+            if use_guard:
+                reason = int(p_m.skip_reason)
+                if reason:
+                    last_skip_reason_host = reason
+                    if is_primary():
+                        print(
+                            f"[guard] step {p_step} skipped "
+                            f"({SKIP_REASON_NAMES.get(reason, reason)}); "
+                            f"params/opt-state unchanged (total skipped: "
+                            f"{int(p_m.skipped_steps)})",
+                            flush=True,
+                        )
+                if int(p_m.skipped_steps) or last_skip_reason_host:
+                    # Pushed only once a skip has happened: a steady
+                    # "skipped: 0" on every CLI line would be noise.
+                    extra = {
+                        "skipped_steps": int(p_m.skipped_steps),
+                        "last_skip_reason": last_skip_reason_host,
+                    }
+                verdict = monitor.observe(float(p_m.loss), skipped=bool(reason))
+                if verdict == "rollback":
+                    rollback_requested = True
+                elif verdict == "anomaly" and not reason and is_primary():
+                    print(
+                        f"[guard] step {p_step} loss spike: "
+                        f"{float(p_m.loss):.4f} (EMA {monitor.mean:.4f}, "
+                        f"{monitor.consecutive} consecutive anomalies)",
+                        flush=True,
+                    )
             # p_step is the post-increment global step; optax evaluated the
             # schedule at count p_step - 1 for that update, so log that one.
-            tracker.update(
-                p_step,
-                loss=float(p_m.loss),
-                lr=float(lr_of(p_step - 1)),
-                grad_norm=float(p_m.grad_norm),
-                epoch=p_epoch,
-                batch=p_batch,
+            # A skipped step's loss/grad_norm are the REJECTED values (the
+            # guard applied the identity update instead): keep them out of the
+            # tracker, whose windowed AVERAGE a single NaN would poison for
+            # the next 50 steps — the [guard] line above already reports them.
+            values = dict(
+                lr=float(lr_of(p_step - 1)), epoch=p_epoch, batch=p_batch,
             )
+            if not (use_guard and int(p_m.skip_reason)):
+                values["loss"] = float(p_m.loss)
+                values["grad_norm"] = float(p_m.grad_norm)
+            tracker.update(p_step, **values, **extra)
 
         done = False
         last_saved_step = -1
+        rollbacks_done = 0
+        fired: set = set()  # in-process one-shot injections (no --save_dir)
         epoch, step_in_epoch = start_epoch, skip_steps
-        for epoch in range(start_epoch, args.epochs):
-            dataset.set_epoch(epoch)
-            tracker.start_epoch(epoch)
-            loader = create_dataloader(
-                dataset,
-                batch_size=local_batch,
-                prefetch_factor=args.prefetch_factor,
-                skip_batches=(skip_steps * args.grad_accum_steps) if epoch == start_epoch else 0,
-            )
-            step_in_epoch = skip_steps if epoch == start_epoch else 0
-            skip_for_this_epoch = step_in_epoch
-
-            # Every optimizer step is a collective: a process whose local
-            # loader yields more batches than another's would dispatch an
-            # extra train_step and block forever on its psum. Bound the epoch
-            # by the cross-process MINIMUM step count — the drop-to-common-
-            # length behavior torch's DistributedSampler gives the reference
-            # implicitly (round-robin shard remainders make per-process batch
-            # counts unequal here).
-            epoch_opt_steps = (
-                _common_min(dataset.batches_per_epoch(local_batch))
-                // args.grad_accum_steps
-            )
-
-            micro: list[tuple[np.ndarray, np.ndarray]] = []
-            for xb, yb in loader:
-                if step_in_epoch >= epoch_opt_steps:
-                    break
-                micro.append((xb, yb))
-                if len(micro) < args.grad_accum_steps:
-                    continue
-                x = np.stack([m[0] for m in micro])
-                y = np.stack([m[1] for m in micro])
-                micro = []
-                x, y = shard_batch((x, y), mesh)
-                params, opt_state, m = train_step(
-                    params, opt_state, x, y, rng, global_step
+        while True:
+            rollback_requested = False
+            for epoch in range(start_epoch, args.epochs):
+                dataset.set_epoch(epoch)
+                tracker.start_epoch(epoch)
+                loader = create_dataloader(
+                    dataset,
+                    batch_size=local_batch,
+                    prefetch_factor=args.prefetch_factor,
+                    skip_batches=(skip_steps * args.grad_accum_steps) if epoch == start_epoch else 0,
                 )
-                global_step += 1
-                step_in_epoch += 1
-                flush_pending()
-                pending = (global_step, epoch, step_in_epoch, m)
+                step_in_epoch = skip_steps if epoch == start_epoch else 0
 
-                if run_eval is not None and global_step % args.eval_every == 0:
+                # Every optimizer step is a collective: a process whose local
+                # loader yields more batches than another's would dispatch an
+                # extra train_step and block forever on its psum. Bound the
+                # epoch by the cross-process MINIMUM step count — the drop-to-
+                # common-length behavior torch's DistributedSampler gives the
+                # reference implicitly (round-robin shard remainders make
+                # per-process batch counts unequal here).
+                epoch_opt_steps = (
+                    _common_min(dataset.batches_per_epoch(local_batch))
+                    // args.grad_accum_steps
+                )
+
+                micro: list[tuple[np.ndarray, np.ndarray]] = []
+                for xb, yb in loader:
+                    if step_in_epoch >= epoch_opt_steps:
+                        break
+                    micro.append((xb, yb))
+                    if len(micro) < args.grad_accum_steps:
+                        continue
+                    x = np.stack([m[0] for m in micro])
+                    y = np.stack([m[1] for m in micro])
+                    micro = []
+                    x, y = shard_batch((x, y), mesh)
+                    if use_guard:
+                        loss_scale = ones_scale
+                        if (
+                            args.inject_nan_at
+                            and global_step + 1 == args.inject_nan_at
+                            and _claim_one_shot(
+                                args.save_dir,
+                                f"nan_injected_{args.inject_nan_at}",
+                                fired,
+                            )
+                        ):
+                            loss_scale = nan_scale
+                            print(
+                                f"[inject] poisoning micro-batch 0 loss with "
+                                f"NaN at step {global_step + 1}",
+                                flush=True,
+                            )
+                        params, opt_state, guard_state, m = train_step(
+                            params, opt_state, guard_state, x, y, rng,
+                            global_step, loss_scale,
+                        )
+                    else:
+                        params, opt_state, m = train_step(
+                            params, opt_state, x, y, rng, global_step
+                        )
+                    global_step += 1
+                    step_in_epoch += 1
                     flush_pending()
-                    # count_tokens=False: this step's training update already
-                    # counted its tokens; eval is out-of-band.
-                    tracker.update(
-                        global_step, count_tokens=False,
-                        eval_loss=run_eval(params),
-                    )
-                if args.save_dir and args.save_every and global_step % args.save_every == 0:
-                    flush_pending()
-                    last_saved_step = global_step
-                    ckpt.save_checkpoint(
-                        args.save_dir, global_step, params, opt_state,
-                        ckpt.CheckpointMeta(
-                            step=global_step, epoch=epoch,
-                            batches_in_epoch=step_in_epoch,
-                            rng_seed=args.seed,
-                            total_tokens=tracker.total_tokens,
-                        ),
-                    )
-                if args.inject_fail_at and global_step >= args.inject_fail_at:
-                    marker = os.path.join(
-                        args.save_dir, f".fail_injected_{args.inject_fail_at}"
-                    )
-                    if not os.path.exists(marker):
+                    pending = (global_step, epoch, step_in_epoch, m)
+                    if rollback_requested:
+                        break
+
+                    if run_eval is not None and global_step % args.eval_every == 0:
                         flush_pending()
-                        tracker.close()
-                        os.makedirs(args.save_dir, exist_ok=True)
-                        with open(marker, "w") as f:
-                            f.write(str(global_step))
+                        # count_tokens=False: this step's training update
+                        # already counted its tokens; eval is out-of-band.
+                        tracker.update(
+                            global_step, count_tokens=False,
+                            eval_loss=run_eval(params),
+                        )
+                    if (
+                        args.save_dir and args.save_every
+                        and global_step % args.save_every == 0
+                    ):
+                        flush_pending()
+                    if (
+                        args.save_dir and args.save_every
+                        and global_step % args.save_every == 0
+                        # re-checked AFTER the flush: never checkpoint a step
+                        # the spike monitor just flagged for rollback — the
+                        # rollback would restore this very checkpoint.
+                        and not rollback_requested
+                    ):
+                        last_saved_step = global_step
+                        ckpt.save_checkpoint(
+                            args.save_dir, global_step, params, opt_state,
+                            ckpt.CheckpointMeta(
+                                step=global_step, epoch=epoch,
+                                batches_in_epoch=step_in_epoch,
+                                rng_seed=args.seed,
+                                total_tokens=tracker.total_tokens,
+                            ),
+                        )
+                    if rollback_requested:
+                        break
+                    if args.inject_fail_at and global_step >= args.inject_fail_at:
+                        marker = os.path.join(
+                            args.save_dir, f".fail_injected_{args.inject_fail_at}"
+                        )
+                        if not os.path.exists(marker):
+                            flush_pending()
+                            tracker.close()
+                            os.makedirs(args.save_dir, exist_ok=True)
+                            with open(marker, "w") as f:
+                                f.write(str(global_step))
+                            print(
+                                f"[inject] simulated failure after step {global_step}",
+                                flush=True,
+                            )
+                            # Hard exit, no teardown/final-save: model a real crash.
+                            os._exit(13)
+                    if (
+                        args.inject_preempt_at
+                        and global_step >= args.inject_preempt_at
+                        and _claim_one_shot(
+                            args.save_dir,
+                            f"preempt_injected_{args.inject_preempt_at}",
+                            fired,
+                        )
+                    ):
                         print(
-                            f"[inject] simulated failure after step {global_step}",
+                            f"[inject] simulated preemption (SIGTERM) after "
+                            f"step {global_step}",
                             flush=True,
                         )
-                        # Hard exit, no teardown/final-save: model a real crash.
-                        os._exit(13)
-                if args.max_steps and global_step >= args.max_steps:
-                    done = True
+                        os.kill(os.getpid(), signal.SIGTERM)
+                    if preempt.preempted():
+                        flush_pending()
+                        if args.profile and args.log_dir:
+                            jax.profiler.stop_trace()
+                        if args.save_dir and global_step != last_saved_step:
+                            ckpt.save_checkpoint(
+                                args.save_dir, global_step, params, opt_state,
+                                ckpt.CheckpointMeta(
+                                    step=global_step, epoch=epoch,
+                                    batches_in_epoch=step_in_epoch,
+                                    rng_seed=args.seed,
+                                    total_tokens=tracker.total_tokens,
+                                ),
+                            )
+                        tracker.close()
+                        preempt.uninstall()
+                        if is_primary():
+                            print(
+                                f"[preempt] emergency checkpoint at step "
+                                f"{global_step}; exiting rc "
+                                f"{PREEMPTED_EXIT_CODE} for a supervised resume",
+                                flush=True,
+                            )
+                        raise SystemExit(PREEMPTED_EXIT_CODE)
+                    if args.max_steps and global_step >= args.max_steps:
+                        done = True
+                        break
+                if done or rollback_requested:
                     break
-            if done:
-                break
-            skip_steps = 0  # later epochs start from batch 0
+                skip_steps = 0  # later epochs start from batch 0
+
+            if rollback_requested and not done:
+                # Layer 2: consecutive anomalies — restore the last verified
+                # checkpoint, keep the data cursor where it is (past the
+                # offending batches, via the loader's O(1) skip), reset the
+                # guard counters and spike baseline, and go again.
+                pending = None
+                monitor.reset()
+                guard_state = init_guard_state()
+                rollbacks_done += 1
+                if rollbacks_done > args.max_rollbacks:
+                    tracker.close()
+                    preempt.uninstall()
+                    raise SystemExit(
+                        f"error: loss diverged through {rollbacks_done} "
+                        f"rollbacks (--max_rollbacks {args.max_rollbacks}); "
+                        f"stopping"
+                    )
+                restored = (
+                    ckpt.restore_latest_verified(
+                        args.save_dir, params, opt_state,
+                        param_shardings, opt_shardings,
+                    )
+                    if args.save_dir else None
+                )
+                start_epoch = epoch
+                skip_steps = step_in_epoch
+                if restored is None:
+                    if is_primary():
+                        print(
+                            "[resilience] rollback requested but no verified "
+                            "checkpoint is available; continuing in place "
+                            "with a reset spike baseline",
+                            flush=True,
+                        )
+                    continue
+                params, opt_state, meta, rpath = restored
+                global_step = meta.step
+                tracker.total_tokens = meta.total_tokens
+                if is_primary():
+                    print(
+                        f"[resilience] rollback #{rollbacks_done}: restored "
+                        f"{rpath} (step {meta.step}); data cursor kept at "
+                        f"epoch {epoch}, {step_in_epoch} opt steps in — the "
+                        f"offending batches are skipped",
+                        flush=True,
+                    )
+                continue
+            break
 
         # --- teardown ---------------------------------------------------------
         flush_pending()
+        preempt.uninstall()
         if args.profile and args.log_dir:
             jax.profiler.stop_trace()
         if args.save_dir and global_step != last_saved_step:

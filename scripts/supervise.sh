@@ -33,6 +33,16 @@ while :; do
         echo "[supervise] interrupted (rc=130); not restarting" >&2
         exit "$rc"
     fi
+    if [ "$rc" -eq 143 ]; then
+        # 128+SIGTERM: the preemption contract (train.py PreemptionHandler).
+        # The run saved an emergency checkpoint and asked to be resumed —
+        # that's cooperative rescheduling, not a failure, so it never burns
+        # one of the MAX_RESTARTS crash attempts.
+        echo "[supervise] preempted (rc=143); resuming from the emergency" \
+             "checkpoint (does not count against MAX_RESTARTS)" >&2
+        sleep "$RESTART_DELAY"
+        continue
+    fi
     attempt=$((attempt + 1))
     if [ "$attempt" -gt "$MAX_RESTARTS" ]; then
         echo "[supervise] giving up after ${MAX_RESTARTS} restarts (last rc=${rc})" >&2

@@ -189,7 +189,10 @@ def main():
         "compiled ahead-of-time by the real XLA TPU compiler against v5e",
         "topology descriptions (no chips needed). Bytes are per-chip HBM from",
         "the executable's buffer assignment. Budget: 15.75 GiB usable (v5e);",
-        "v4 = 32 GiB has 2x headroom.\n",
+        "v4 = 32 GiB has 2x headroom. \"fits\" means peak <",
+        f"{HBM_BUDGET_GIB} + {FIT_SLACK_GIB} GiB slack (the byte sums round",
+        "to 0.01-GiB granularity, so a row AT the budget edge still reads",
+        "\"yes\" — the measured-run caveat below the table is ground truth).\n",
         "| preset | params | topology | mesh (data,fsdp) | micro-batch/chip "
         "| accum | remat | args GiB | temps GiB | peak GiB/chip | fits |",
         "|" + "---|" * 11,
@@ -218,7 +221,8 @@ def main():
             "17.4 GiB for 1.5B (**cannot fit** f32 master state in 15.75 GiB",
             "— the compiler verdict below is the proof; multi-chip FSDP or a",
             "sharded-state host-offload design is required, matching",
-            "BASELINE config 5's v4-32 placement).",
+            "BASELINE config 5's v4-32 placement). Same fits rule: peak <",
+            f"{HBM_BUDGET_GIB} + {FIT_SLACK_GIB} GiB slack.",
             "",
             "| preset | micro-batch | accum | remat | carry | args GiB "
             "| temps GiB | peak GiB/chip | fits |",

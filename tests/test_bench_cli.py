@@ -128,6 +128,70 @@ def test_resilient_double_failure_yields_error_record(monkeypatch):
     assert rec["value"] is None
 
 
+def test_resilient_forwards_operating_point_flags(monkeypatch):
+    # The child subprocess must bench the SAME operating point the parent was
+    # given — the invariant lives next to the cmd construction (ADVICE round
+    # 5), not in suite mode's parse-time rejection of overrides.
+    bench = _import_bench()
+    calls = []
+
+    def fake_run(cmd, **kwargs):
+        calls.append(cmd)
+
+        class R:
+            returncode = 0
+            stdout = '{"value": 1.0}\n'
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    args = bench.argparse.Namespace(
+        steps=30, warmup=2, batch=8, grad_accum_steps=2, remat="mlp",
+        accum_dtype="bf16", unroll_accum=True, loss_block_rows=512,
+        scan_layers="on",
+    )
+    bench.run_config_resilient(args, model="124M", seq_len=1024)
+    cmd = calls[0]
+    for flag, val in (
+        ("--batch", "8"),
+        ("--grad_accum_steps", "2"),
+        ("--remat", "mlp"),
+        ("--accum_dtype", "bf16"),
+        ("--loss_block_rows", "512"),
+        ("--scan_layers", "on"),
+    ):
+        assert flag in cmd and val in cmd, (flag, cmd)
+    assert "--unroll_accum" in cmd
+    # At-defaults args (the suite path) forward nothing extra.
+    calls.clear()
+    bench.run_config_resilient(_suite_args(bench), model="124M", seq_len=1024)
+    assert not any(f in calls[0] for f in (
+        "--batch", "--grad_accum_steps", "--remat", "--accum_dtype",
+        "--unroll_accum", "--loss_block_rows", "--scan_layers",
+    )), calls[0]
+
+
+def test_resilient_labels_parse_failure_distinctly(monkeypatch):
+    # rc=0 with unparseable stdout is a protocol bug in the child, not a
+    # child crash — the error record must say so (ADVICE round 5: the broad
+    # except lumped JSON decode errors in with subprocess failures).
+    bench = _import_bench()
+
+    def fake_run(cmd, **kwargs):
+        class R:
+            returncode = 0
+            stdout = "no json anywhere\n"
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    rec = bench.run_config_resilient(_suite_args(bench), model="124M", seq_len=1024)
+    assert "parse failure (child rc=0)" in rec["error"]
+    assert rec["value"] is None
+
+
 def test_default_suite_rejects_operating_point_overrides(tmp_path):
     # No --model/--seq_len => suite mode; forced operating points or global
     # remat/CE overrides would record suite numbers that aren't the headline

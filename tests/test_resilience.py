@@ -1,0 +1,349 @@
+"""Resilience subsystem tests: the four defense layers of resilience.py.
+
+Layer 1 (in-step non-finite guard), layer 2 (SpikeMonitor + rollback), layer 3
+(manifest CRC + verified-restore fallback), layer 4 (SIGTERM preemption ->
+emergency save rc 143). Everything runs under JAX_PLATFORMS=cpu; the CLI
+integration tests drive the real driver the way tests/test_train_cli.py does.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+from gpt_2_distributed_tpu import resilience
+from gpt_2_distributed_tpu import train as train_mod
+from gpt_2_distributed_tpu.resilience import (
+    PREEMPTED_EXIT_CODE,
+    SKIP_NONFINITE_GRAD,
+    SKIP_NONFINITE_LOSS,
+    SpikeMonitor,
+    crc32c,
+    init_guard_state,
+    verify_checkpoint,
+    write_manifest,
+)
+
+
+# --- layer 1: guarded train step --------------------------------------------
+
+
+def _tiny_setup():
+    import jax
+    import jax.numpy as jnp
+
+    from gpt_2_distributed_tpu.config import GPT2Config
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    cfg = GPT2Config(
+        vocab_size=257, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+        embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
+    )
+    params = gpt2.init_params(cfg)
+    opt = make_optimizer(1e-3)
+    opt_state = opt.init(params)
+    step = make_train_step(
+        cfg, opt, compute_dtype=jnp.float32, donate=False, guard=True
+    )
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 257, (2, 4, 16)).astype(np.int32)
+    y = rng.integers(0, 257, (2, 4, 16)).astype(np.int32)
+    return jax, jnp, step, params, opt_state, x, y
+
+
+def _trees_equal(a, b) -> bool:
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+@pytest.mark.parametrize("poison", [float("nan"), float("inf")])
+def test_guard_skips_nonfinite_loss_bit_exact(poison):
+    jax, jnp, step, params, opt_state, x, y = _tiny_setup()
+    key = jax.random.PRNGKey(0)
+    gs = init_guard_state()
+    ones = jnp.ones((2,), jnp.float32)
+    bad = ones.at[0].set(poison)
+
+    p1, o1, gs1, m1 = step(params, opt_state, gs, x, y, key, 0, ones)
+    assert int(m1.skipped_steps) == 0 and int(m1.skip_reason) == 0
+    assert np.isfinite(float(m1.loss))
+
+    # Poisoned step: identity update, counter bumps, reason recorded.
+    p2, o2, gs2, m2 = step(p1, o1, gs1, x, y, key, 1, bad)
+    assert int(m2.skipped_steps) == 1
+    assert int(m2.skip_reason) == SKIP_NONFINITE_LOSS
+    assert not np.isfinite(float(m2.loss))
+    assert _trees_equal(p1, p2), "params changed across a skipped step"
+    assert _trees_equal(o1, o2), "opt_state changed across a skipped step"
+
+    # Clean step right after: applies normally, counter stays at 1.
+    p3, _o3, gs3, m3 = step(p2, o2, gs2, x, y, key, 2, ones)
+    assert int(m3.skipped_steps) == 1 and int(m3.skip_reason) == 0
+    assert int(gs3.last_skip_reason) == SKIP_NONFINITE_LOSS
+    assert not _trees_equal(p2, p3), "clean step after a skip must update"
+
+
+def test_guard_reason_codes_distinct():
+    # The reason taxonomy is part of the metric contract (TB series values).
+    assert SKIP_NONFINITE_LOSS != SKIP_NONFINITE_GRAD
+    assert resilience.SKIP_REASON_NAMES[SKIP_NONFINITE_LOSS] == "nonfinite_loss"
+    assert resilience.SKIP_REASON_NAMES[SKIP_NONFINITE_GRAD] == "nonfinite_grad"
+
+
+# --- layer 2: SpikeMonitor ---------------------------------------------------
+
+
+def test_spike_monitor_validates_args():
+    with pytest.raises(ValueError):
+        SpikeMonitor(sigma=0.0)
+    with pytest.raises(ValueError):
+        SpikeMonitor(max_consecutive=0)
+
+
+def test_spike_monitor_skipped_steps_escalate_to_rollback():
+    mon = SpikeMonitor(max_consecutive=3)
+    assert mon.observe(float("nan"), skipped=True) == "anomaly"
+    assert mon.observe(float("nan"), skipped=True) == "anomaly"
+    assert mon.observe(float("nan"), skipped=True) == "rollback"
+
+
+def test_spike_monitor_healthy_step_resets_consecutive():
+    mon = SpikeMonitor(max_consecutive=2)
+    assert mon.observe(1.0, skipped=True) == "anomaly"
+    assert mon.observe(1.0) is None  # healthy: streak broken
+    assert mon.observe(1.0, skipped=True) == "anomaly"
+    assert mon.observe(1.0, skipped=True) == "rollback"
+
+
+def test_spike_monitor_warmup_tolerates_loss_cliff():
+    # The fresh-run loss cliff (e.g. 10.9 -> 4.x within a few steps) must not
+    # read as a spike: z-scoring engages only after `warmup` healthy steps.
+    mon = SpikeMonitor(warmup=20)
+    for loss in np.linspace(11.0, 4.0, 15):
+        assert mon.observe(float(loss)) is None
+
+
+def test_spike_monitor_flags_upward_spike_and_keeps_baseline():
+    mon = SpikeMonitor(sigma=6.0, warmup=10)
+    for _ in range(25):
+        assert mon.observe(1.0) is None
+    baseline = mon.mean
+    assert mon.observe(50.0) == "anomaly"
+    # The spike must NOT poison the EMA it is judged against.
+    assert mon.mean == pytest.approx(baseline)
+    # Downward jumps are not pathological (one-sided threshold).
+    assert mon.observe(0.2) is None
+
+
+def test_spike_monitor_reset():
+    mon = SpikeMonitor(max_consecutive=2)
+    for _ in range(30):
+        mon.observe(1.0)
+    mon.observe(1.0, skipped=True)
+    mon.reset()
+    assert mon.consecutive == 0 and mon.n_healthy == 0
+    assert mon.observe(1.0, skipped=True) == "anomaly"  # not rollback
+
+
+# --- layer 3: manifest + verification ---------------------------------------
+
+
+def test_crc32c_check_value():
+    # The CRC-32C (Castagnoli) check value, e.g. RFC 3720 appendix B.4.
+    assert crc32c(b"123456789") == 0xE3069283
+    # Chunked == one-shot (the file hasher feeds 256 KiB chunks).
+    assert crc32c(b"6789", crc32c(b"12345")) == 0xE3069283
+
+
+def _fake_checkpoint(path, step=3):
+    os.makedirs(os.path.join(path, "params"), exist_ok=True)
+    os.makedirs(os.path.join(path, "opt_state"), exist_ok=True)
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"step": step, "epoch": 0}, f)
+    with open(os.path.join(path, "params", "data.bin"), "wb") as f:
+        f.write(b"\x01\x02" * 512)
+    return path
+
+
+def test_manifest_roundtrip_and_tamper_detection(tmp_path):
+    path = _fake_checkpoint(str(tmp_path / "step_0000003"))
+    write_manifest(path, 3)
+    assert verify_checkpoint(path) == []
+
+    # Same-size corruption: only the CRC can catch it.
+    data = os.path.join(path, "params", "data.bin")
+    with open(data, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff")
+    problems = verify_checkpoint(path)
+    assert problems and "crc32c" in problems[0]
+
+    # Truncation: caught by size (works even past CRC_MAX_BYTES).
+    with open(data, "wb") as f:
+        f.write(b"\x01")
+    problems = verify_checkpoint(path)
+    assert any("size" in p for p in problems)
+
+    # Missing file.
+    os.remove(data)
+    problems = verify_checkpoint(path)
+    assert any("missing" in p for p in problems)
+
+
+def test_verify_legacy_checkpoint_without_manifest(tmp_path):
+    # Pre-manifest checkpoints stay restorable (structural checks only)...
+    path = _fake_checkpoint(str(tmp_path / "step_0000001"))
+    assert verify_checkpoint(path) == []
+    # ...but a truncated meta.json still fails even without a manifest.
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        f.write('{"step"')
+    assert any("meta.json" in p for p in verify_checkpoint(path))
+
+
+# --- CLI integration ---------------------------------------------------------
+
+
+def run_cli(capsys, *argv):
+    train_mod.main(list(argv))
+    return capsys.readouterr().out
+
+
+def _common(shard_dir, tmp_path, ckpt_name="ckpt"):
+    return [
+        "--data_dir", shard_dir,
+        "--n_layer", "2",
+        "--n_embd", "32",
+        "--n_head", "2",
+        "--vocab_size", "257",
+        "--seq_len", "32",
+        "--batch", "4",
+        "--grad_accum_steps", "2",
+        "--lr", "1e-3",
+        "--cli_every", "1",
+        "--save_dir", str(tmp_path / ckpt_name),
+    ]
+
+
+def _raw_params(path):
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.join(path, "params"))
+
+
+def test_cli_inject_nan_skips_one_step_bit_exact(capsys, shard_dir, tmp_path):
+    out = run_cli(
+        capsys, *_common(shard_dir, tmp_path),
+        "--save_every", "1", "--max_steps", "4", "--inject_nan_at", "3",
+    )
+    assert "skipped (nonfinite_loss)" in out
+    assert "skipped: 1" in out
+    assert "training done: 4 optimizer steps" in out
+    ckpt_dir = tmp_path / "ckpt"
+    p2 = _raw_params(str(ckpt_dir / "step_0000002"))
+    p3 = _raw_params(str(ckpt_dir / "step_0000003"))
+    p4 = _raw_params(str(ckpt_dir / "step_0000004"))
+    assert _trees_equal(p2, p3), "skipped step must leave params bit-identical"
+    assert not _trees_equal(p3, p4), "the next clean step must train again"
+
+
+def test_cli_inject_nan_requires_guard(shard_dir, tmp_path):
+    with pytest.raises(SystemExit):
+        train_mod.main(
+            _common(shard_dir, tmp_path)
+            + ["--max_steps", "2", "--inject_nan_at", "1", "--step_guard", "off"]
+        )
+
+
+def test_cli_spike_rollback_restores_and_completes(capsys, shard_dir, tmp_path):
+    out = run_cli(
+        capsys, *_common(shard_dir, tmp_path),
+        "--save_every", "2", "--max_steps", "6", "--inject_nan_at", "4",
+        "--max_consecutive_skips", "1",
+    )
+    assert "skipped (nonfinite_loss)" in out
+    assert "[resilience] rollback #1: restored" in out
+    assert "step_0000002" in out  # the last checkpoint NOT flagged by the monitor
+    assert "training done: 6 optimizer steps" in out
+
+
+def test_cli_resume_falls_back_past_two_corrupt_checkpoints(
+    capsys, shard_dir, tmp_path
+):
+    common = _common(shard_dir, tmp_path)
+    run_cli(capsys, *common, "--save_every", "1", "--max_steps", "3")
+    ckpt_dir = tmp_path / "ckpt"
+
+    # Newest: truncated meta.json (size mismatch + unparseable).
+    with open(ckpt_dir / "step_0000003" / "meta.json", "w") as f:
+        f.write('{"step"')
+    # Second-newest: same-size bit flip — still valid JSON, only CRC catches
+    # it (re-point total_tokens at a different digit).
+    meta2 = ckpt_dir / "step_0000002" / "meta.json"
+    text = meta2.read_text()
+    m = re.search(r'"total_tokens": (\d)', text)
+    assert m, text
+    flipped = "1" if m.group(1) != "1" else "2"
+    meta2.write_text(
+        text[: m.start(1)] + flipped + text[m.end(1):], encoding="utf-8"
+    )
+
+    out = run_cli(capsys, *common, "--save_every", "100", "--max_steps", "4", "--resume")
+    assert out.count("[resilience] discarding corrupt checkpoint") == 2
+    assert "step_0000003: meta.json unreadable" in out
+    assert "step_0000002: meta.json: crc32c" in out
+    assert "resumed from" in out and "step_0000001" in out
+    assert "training done: 4 optimizer steps" in out
+
+
+def test_cli_preempt_emergency_save_and_bit_exact_resume(
+    capsys, shard_dir, tmp_path
+):
+    # Uninterrupted reference run.
+    run_cli(
+        capsys, *_common(shard_dir, tmp_path, "ckpt_ref"),
+        "--save_every", "100", "--max_steps", "6",
+    )
+    ref = _raw_params(str(tmp_path / "ckpt_ref" / "step_0000006"))
+
+    # Same run preempted after step 3: SIGTERM via os.kill (the injection
+    # delivers the real signal through the real handler), emergency save,
+    # SystemExit rc 143.
+    with pytest.raises(SystemExit) as exc:
+        train_mod.main(
+            _common(shard_dir, tmp_path)
+            + ["--save_every", "100", "--max_steps", "6",
+               "--inject_preempt_at", "3"]
+        )
+    assert exc.value.code == PREEMPTED_EXIT_CODE
+    out = capsys.readouterr().out
+    assert "[preempt] received signal" in out
+    assert "[preempt] emergency checkpoint at step 3" in out
+    emergency = tmp_path / "ckpt" / "step_0000003"
+    assert emergency.is_dir()
+    assert verify_checkpoint(str(emergency)) == []
+
+    # Supervised-style resume continues to the same params bit-for-bit.
+    out = run_cli(
+        capsys, *_common(shard_dir, tmp_path),
+        "--save_every", "100", "--max_steps", "6", "--resume",
+    )
+    assert "resumed from" in out and "step 3" in out
+    assert "training done: 6 optimizer steps" in out
+    resumed = _raw_params(str(tmp_path / "ckpt" / "step_0000006"))
+    assert _trees_equal(ref, resumed), (
+        "preempt + resume must land on the uninterrupted run's trajectory"
+    )

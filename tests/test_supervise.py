@@ -52,6 +52,62 @@ def test_supervise_bounded_restarts_then_gives_up():
     assert "giving up after 2 restarts" in r.stderr
 
 
+def test_supervise_preemption_rc143_does_not_burn_attempts(tmp_path):
+    # rc 143 is the preemption contract (train.py PreemptionHandler): the
+    # wrapper must relaunch WITHOUT counting a MAX_RESTARTS attempt — proven
+    # by MAX_RESTARTS=0, under which any counted failure would give up
+    # immediately. The stub "trainer" exits 143 twice (marker files), then 0.
+    marker = tmp_path / "preempts"
+    script = tmp_path / "fake_train.sh"
+    script.write_text(
+        "#!/usr/bin/env bash\n"
+        f'n=$(ls "{marker}".* 2>/dev/null | wc -l)\n'
+        'if [ "$n" -lt 2 ]; then\n'
+        f'  touch "{marker}.$n"\n'
+        "  exit 143\n"
+        "fi\n"
+        "exit 0\n"
+    )
+    script.chmod(0o755)
+    r = subprocess.run(
+        ["bash", SUPERVISE, "bash", str(script)], env=_env("0"),
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert r.stderr.count("preempted (rc=143)") == 2
+    assert "giving up" not in r.stderr
+
+
+def test_supervise_preempt_nan_grand_e2e(shard_dir, tmp_path):
+    """The full resilience story through the wrapper: a NaN-poisoned step is
+    skipped in place (guard), a SIGTERM preemption emergency-saves and exits
+    rc 143, supervise relaunches without burning an attempt (MAX_RESTARTS=0),
+    and the resumed run completes the full step budget."""
+    save_dir = str(tmp_path / "ckpt")
+    cmd = [
+        "bash", SUPERVISE,
+        sys.executable, "-m", "gpt_2_distributed_tpu.train",
+        "--data_dir", shard_dir,
+        "--n_layer", "2", "--n_embd", "32", "--n_head", "2",
+        "--vocab_size", "257", "--seq_len", "32", "--batch", "4",
+        "--grad_accum_steps", "1", "--lr", "1e-3", "--cli_every", "100",
+        "--max_steps", "12", "--save_every", "4", "--save_dir", save_dir,
+        "--inject_nan_at", "3", "--inject_preempt_at", "6",
+    ]
+    r = subprocess.run(
+        cmd, env=_env("0"), cwd=REPO,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "[guard] step 3 skipped (nonfinite_loss)" in r.stdout
+    assert "[preempt] emergency checkpoint at step 6" in r.stdout
+    assert "preempted (rc=143)" in r.stderr
+    assert "resumed from" in r.stdout and "step 6" in r.stdout
+    assert "training done: 12 optimizer steps" in r.stdout
+    dirs = os.listdir(save_dir)
+    assert "step_0000006" in dirs and "step_0000012" in dirs
+
+
 def test_supervise_crash_resume_completes_run(shard_dir, tmp_path):
     """Kill training mid-epoch; the relaunch must resume from the checkpoint
     cursor (step 6, the last save before the step-7 crash) and finish."""
