@@ -127,6 +127,13 @@ def main() -> None:
         "faster steps — XLA schedules across layer boundaries only when "
         "unrolled, see PERF_ANALYSIS.md). 'auto' unrolls 124M/345M.",
     )
+    p.add_argument(
+        "--fused_layers", default="off", choices=["off", "ln", "gelu", "all"],
+        help="fused Pallas layer-epilogue kernels (ops/fused_layer.py): 'ln' "
+        "= residual+dropout+layernorm junctions, 'gelu' = MLP bias+GELU+"
+        "dropout epilogue, 'all' = both. Default off until the marginal "
+        "microbench (scripts/bench_fused.py) confirms the win on-chip",
+    )
     args = p.parse_args()
     args.steps = max(1, args.steps)
     args.warmup = max(1, args.warmup)  # first call doubles as the compile step
@@ -144,6 +151,7 @@ def main() -> None:
                 ("--unroll_accum", args.unroll_accum),
                 ("--accum_dtype", args.accum_dtype != "auto"),
                 ("--loss_block_rows", args.loss_block_rows),
+                ("--fused_layers", args.fused_layers != "off"),
             ) if hit
         ]
         if overrides:
@@ -251,6 +259,8 @@ def run_config_resilient(args, model: str, seq_len: int) -> dict:
         cmd += ["--loss_block_rows", str(args.loss_block_rows)]
     if getattr(args, "scan_layers", "auto") != "auto":
         cmd += ["--scan_layers", args.scan_layers]
+    if getattr(args, "fused_layers", "off") != "off":
+        cmd += ["--fused_layers", args.fused_layers]
     errors = []
     for attempt in (1, 2):
         try:
@@ -363,6 +373,8 @@ def run_config(args, model: str, seq_len: int) -> dict:
     )
     if args.loss_block_rows:
         config = config.replace(loss_block_rows=args.loss_block_rows)
+    if getattr(args, "fused_layers", "off") != "off":
+        config = config.replace(fused_layers=args.fused_layers)
     if args.batch:
         micro_batch = args.batch
     elif not on_tpu:
@@ -408,6 +420,18 @@ def run_config(args, model: str, seq_len: int) -> dict:
         grad_accum = 8 if on_tpu else 1
     seq_len = seq_len if on_tpu else min(seq_len, 256)
     steps = args.steps if on_tpu else max(2, args.steps // 5)
+
+    # stdout must stay the single JSON result line, so operating-point
+    # warnings go to stderr.
+    from gpt_2_distributed_tpu.utils.operating_point import (
+        accum_cliff_message, warn_once,
+    )
+    cliff = accum_cliff_message(seq_len, grad_accum, scan_layers)
+    if cliff:
+        warn_once(
+            "accum_cliff", cliff,
+            printer=lambda m: sys.stderr.write(m + "\n"),
+        )
 
     spec = MeshSpec(data=n_chips, fsdp=1)
     mesh = create_mesh(spec)

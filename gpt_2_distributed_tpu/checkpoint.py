@@ -52,6 +52,11 @@ class CheckpointMeta:
     batches_in_epoch: int     # optimizer steps consumed within `epoch`
     rng_seed: int             # the run's base PRNG seed
     total_tokens: int = 0
+    # SpikeMonitor.state_dict() — the EMA loss baseline, so --resume keeps
+    # spike detection armed instead of rebuilding through a warmup window
+    # (resilience.py). None for guard-off runs; the default keeps meta.json
+    # files written before this field loadable (from_json passes **kwargs).
+    spike_monitor: dict | None = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2)

@@ -67,6 +67,16 @@ class GPT2Config:
     # to ~2e-3 (pinned in tests/test_losses.py). fp32 inputs are
     # bit-identical on both paths.
     loss_impl: str = "blocked"
+    # Fused Pallas layer-epilogue kernels (ops/fused_layer.py), attacking the
+    # between-matmul bandwidth gap PERF_ANALYSIS.md §9 measured: "ln" fuses
+    # the attention->MLP junction (proj-dropout + residual + ln2, plus the
+    # block-closing residual+dropout); "gelu" fuses the MLP's bias + tanh-GELU
+    # + activation-dropout epilogue over the [*, 4C] tensor; "all" = both.
+    # Default "off" until the marginal microbench (scripts/bench_fused.py)
+    # proves the win on-chip. Shapes/meshes the kernels can't host (C not
+    # 128-aligned, sp/tp-sharded activations, decode's T=1 rows) fall back to
+    # the unfused path automatically — same math, different dropout stream.
+    fused_layers: str = "off"
     # Row-chunk size of the blocked CE ([rows, V] transient logits per
     # chunk). The default (ops/losses.py DEFAULT_BLOCK_ROWS — single source
     # of truth) is the measured v5e throughput optimum at 124M/345M
@@ -85,6 +95,11 @@ class GPT2Config:
             raise ValueError(
                 f"attention_impl={self.attention_impl!r}: expected "
                 "'auto', 'dense', 'flash' or 'ring'"
+            )
+        if self.fused_layers not in ("off", "ln", "gelu", "all"):
+            raise ValueError(
+                f"fused_layers={self.fused_layers!r}: expected "
+                "'off', 'ln', 'gelu' or 'all'"
             )
         if self.loss_impl not in ("blocked", "dense"):
             raise ValueError(

@@ -40,6 +40,11 @@ import jax.numpy as jnp
 from gpt_2_distributed_tpu.config import GPT2Config
 from gpt_2_distributed_tpu.ops.activations import gelu_tanh
 from gpt_2_distributed_tpu.ops.attention import causal_attention, select_attention_impl
+from gpt_2_distributed_tpu.ops.fused_layer import (
+    fused_bias_gelu_dropout,
+    fused_ln_residual_dropout,
+    fused_residual_dropout,
+)
 from gpt_2_distributed_tpu.ops.layers import dropout, layer_norm
 from gpt_2_distributed_tpu.ops.losses import blocked_cross_entropy
 
@@ -197,6 +202,39 @@ def _attn_sublayer(
     return x + o
 
 
+def _gelu_fused(config: GPT2Config) -> bool:
+    return config.fused_layers in ("gelu", "all")
+
+
+def _ln_fused(config: GPT2Config) -> bool:
+    return config.fused_layers in ("ln", "all")
+
+
+def _mlp_core(
+    config: GPT2Config,
+    y: jnp.ndarray,  # [B, T, C] post-ln2, compute dtype
+    bp: dict[str, jnp.ndarray],
+    rng: jax.Array | None,
+    deterministic: bool,
+) -> jnp.ndarray:
+    """fc matmul -> bias -> tanh-GELU -> activation dropout ([B, T, 4C]).
+
+    With ``fused_layers`` in ("gelu", "all") the bias add, GELU and dropout
+    run as one Pallas epilogue kernel over the matmul output — the [*, 4C]
+    tensor is the largest between-matmul bandwidth pass in the block
+    (ops/fused_layer.py); otherwise the unfused reference composition."""
+    cdt = y.dtype
+    if _gelu_fused(config):
+        h = y @ bp["mlp_fc_w"].astype(cdt)
+        return fused_bias_gelu_dropout(
+            h, bp["mlp_fc_b"].astype(cdt),
+            rate=config.resid_dropout, rng=rng, deterministic=deterministic,
+        )
+    y = y @ bp["mlp_fc_w"].astype(cdt) + bp["mlp_fc_b"].astype(cdt)
+    y = gelu_tanh(y)
+    return dropout(y, config.resid_dropout, rng, deterministic)
+
+
 def _mlp_sublayer(
     config: GPT2Config,
     x: jnp.ndarray,  # [B, T, C] in compute dtype
@@ -212,12 +250,70 @@ def _mlp_sublayer(
     else:
         r_mact = r_mresid = None
     y = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"], config.layer_norm_eps)
-    y = y @ bp["mlp_fc_w"].astype(cdt) + bp["mlp_fc_b"].astype(cdt)
-    y = gelu_tanh(y)
-    y = dropout(y, config.resid_dropout, r_mact, deterministic)
+    y = _mlp_core(config, y, bp, r_mact, deterministic)
     y = y @ bp["mlp_proj_w"].astype(cdt) + bp["mlp_proj_b"].astype(cdt)
     y = dropout(y, config.resid_dropout, r_mresid, deterministic)
     return x + y
+
+
+def _attn_half_fused(
+    config: GPT2Config,
+    x: jnp.ndarray,  # [B, T, C] in compute dtype
+    bp: dict[str, jnp.ndarray],
+    rng: jax.Array | None,
+    deterministic: bool,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Attention sublayer ending in the fused LN+residual+dropout junction.
+
+    Returns ``(r, y2)``: the post-attention residual stream ``r = x +
+    dropout(proj(attn(ln1(x))))`` and ``y2 = ln2(r)``, the MLP's input —
+    computed in one kernel pass (ops/fused_layer.py) instead of three
+    bandwidth passes. The attention body is identical to ``_attn_sublayer``
+    (which stays the decode-mirror reference; models/decode.py note there)."""
+    b, t, c = x.shape
+    cdt = x.dtype
+    if rng is not None:
+        r_attn, r_aresid = jax.random.split(rng)
+    else:
+        r_attn = r_aresid = None
+
+    y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
+    q, k, v = qkv_proj(config, y, bp)
+    attn_fn = select_attention_impl(config.attention_impl, t)
+    o = attn_fn(
+        q, k, v,
+        dropout_rate=config.attn_dropout, rng=r_attn, deterministic=deterministic,
+    )
+    o = o.reshape(b, t, c)
+    o = o @ bp["attn_proj_w"].astype(cdt) + bp["attn_proj_b"].astype(cdt)
+    return fused_ln_residual_dropout(
+        x, o, bp["ln2_scale"], bp["ln2_bias"],
+        eps=config.layer_norm_eps, rate=config.resid_dropout,
+        rng=r_aresid, deterministic=deterministic,
+    )
+
+
+def _mlp_half_fused(
+    config: GPT2Config,
+    x: jnp.ndarray,   # [B, T, C] post-attention residual stream
+    y2: jnp.ndarray,  # [B, T, C] ln2(x), produced by _attn_half_fused
+    bp: dict[str, jnp.ndarray],
+    rng: jax.Array | None,
+    deterministic: bool,
+) -> jnp.ndarray:
+    """MLP sublayer consuming the pre-normalized ``y2`` and closing the block
+    with the fused residual+dropout kernel. The block-final LN is NOT fused
+    here — it belongs to the next block across the scan boundary."""
+    cdt = x.dtype
+    if rng is not None:
+        r_mact, r_mresid = jax.random.split(rng)
+    else:
+        r_mact = r_mresid = None
+    y = _mlp_core(config, y2, bp, r_mact, deterministic)
+    y = y @ bp["mlp_proj_w"].astype(cdt) + bp["mlp_proj_b"].astype(cdt)
+    return fused_residual_dropout(
+        x, y, rate=config.resid_dropout, rng=r_mresid, deterministic=deterministic,
+    )
 
 
 def _block(
@@ -232,6 +328,28 @@ def _block(
         r_attn, r_mlp = jax.random.split(rng)
     else:
         r_attn = r_mlp = None
+    if _ln_fused(config):
+        # Fused-junction layout: the attention half ends in the fused
+        # LN+residual+dropout kernel and hands (r, ln2(r)) straight to the
+        # MLP half, which closes the block with the fused residual kernel.
+        # The remat split mirrors the unfused dispatch below — each half is
+        # a checkpointable unit with the same save/replay trade-offs.
+        attn_half = _attn_half_fused
+        mlp_half = _mlp_half_fused
+        if config.remat == "mlp":
+            mlp_half = jax.checkpoint(_mlp_half_fused, static_argnums=(0, 5))
+        elif config.remat == "attn":
+            attn_half = jax.checkpoint(_attn_half_fused, static_argnums=(0, 4))
+        elif config.remat == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            attn_half = jax.checkpoint(
+                _attn_half_fused, policy=policy, static_argnums=(0, 4)
+            )
+            mlp_half = jax.checkpoint(
+                _mlp_half_fused, policy=policy, static_argnums=(0, 5)
+            )
+        x, y2 = attn_half(config, x, bp, r_attn, deterministic)
+        return mlp_half(config, x, y2, bp, r_mlp, deterministic)
     attn = _attn_sublayer
     mlp = _mlp_sublayer
     if config.remat == "mlp":

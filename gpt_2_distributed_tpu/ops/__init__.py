@@ -1,5 +1,18 @@
 from gpt_2_distributed_tpu.ops.activations import gelu_tanh
 from gpt_2_distributed_tpu.ops.attention import causal_attention
+from gpt_2_distributed_tpu.ops.fused_layer import (
+    fused_bias_gelu_dropout,
+    fused_ln_residual_dropout,
+    fused_residual_dropout,
+)
 from gpt_2_distributed_tpu.ops.layers import dropout, layer_norm
 
-__all__ = ["gelu_tanh", "causal_attention", "dropout", "layer_norm"]
+__all__ = [
+    "gelu_tanh",
+    "causal_attention",
+    "dropout",
+    "layer_norm",
+    "fused_bias_gelu_dropout",
+    "fused_ln_residual_dropout",
+    "fused_residual_dropout",
+]

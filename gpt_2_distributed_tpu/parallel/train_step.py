@@ -26,6 +26,12 @@ deliberate:
 * **Mixed precision**: params/opt-state fp32, compute bf16 (casts inside the
   model), loss/grads fp32 — the autocast-bf16 + fp32-master-weights scheme of
   the reference (``:404``, SURVEY.md §2.2).
+
+The fused layer-epilogue kernels (``ops/fused_layer.py``, selected by
+``GPT2Config.fused_layers``) need no wiring here: the flag rides inside the
+config that ``make_train_step`` closes over, and the fused paths carry their
+own ``jax.custom_vjp`` rules, so grad/accumulate/update are oblivious to
+whether the model ran fused or unfused epilogues.
 """
 
 from __future__ import annotations

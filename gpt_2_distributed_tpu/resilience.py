@@ -126,6 +126,26 @@ class SpikeMonitor:
         self.n_healthy = 0
         self.consecutive = 0
 
+    def state_dict(self) -> dict:
+        """JSON-serializable EMA baseline for checkpoint ``meta.json``
+        (ROADMAP resilience follow-up b): persisting mean/var/n_healthy lets
+        a ``--resume`` relaunch keep its spike baseline instead of sitting
+        through a fresh ``warmup`` window blind to spikes. ``consecutive`` is
+        deliberately NOT saved — an anomaly streak must not survive a
+        restart that may well have fixed its cause."""
+        return {
+            "mean": self.mean,
+            "var": self.var,
+            "n_healthy": self.n_healthy,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a ``state_dict()`` baseline; resets the anomaly streak."""
+        self.mean = float(state["mean"])
+        self.var = float(state["var"])
+        self.n_healthy = int(state["n_healthy"])
+        self.consecutive = 0
+
     def _threshold(self) -> float:
         # Std floor: a converged, nearly-flat loss would otherwise turn
         # ordinary batch noise into huge z-scores.

@@ -196,6 +196,15 @@ def build_parser() -> argparse.ArgumentParser:
         "micro-batches; see PERF_ANALYSIS.md)",
     )
     p.add_argument(
+        "--fused_layers", default="off", choices=["off", "ln", "gelu", "all"],
+        help="fused Pallas layer-epilogue kernels (ops/fused_layer.py): 'ln' "
+        "fuses residual+dropout+layernorm at the sublayer junctions, 'gelu' "
+        "fuses the MLP's bias+GELU+dropout epilogue, 'all' both. Default "
+        "'off' until the marginal microbench (scripts/bench_fused.py) "
+        "confirms the win on-chip; unsupported shapes/meshes fall back to "
+        "the unfused path automatically",
+    )
+    p.add_argument(
         "--loss_block_rows", type=int, default=0,
         help="blocked-CE chunk rows (0 = preset default "
         f"{DEFAULT_BLOCK_ROWS}; smaller trades throughput for peak-HBM "
@@ -356,6 +365,8 @@ def main(argv: list[str] | None = None) -> None:
         config = config.replace(attention_impl=args.attention_impl)
     if args.loss_block_rows:
         config = config.replace(loss_block_rows=args.loss_block_rows)
+    if args.fused_layers != "off":
+        config = config.replace(fused_layers=args.fused_layers)
 
     # --- mesh ---------------------------------------------------------------
     try:
@@ -397,6 +408,16 @@ def main(argv: list[str] | None = None) -> None:
             f"({config.num_params()/1e6:.1f}M params) | "
             f"steps/epoch: {steps_per_epoch}"
         )
+        from gpt_2_distributed_tpu.utils.operating_point import (
+            accum_cliff_message,
+            warn_once,
+        )
+
+        cliff = accum_cliff_message(
+            args.seq_len, args.grad_accum_steps, config.scan_layers
+        )
+        if cliff:
+            warn_once("accum_cliff", cliff)
 
     schedule = make_lr_schedule(args, steps_per_epoch)
     optimizer = make_optimizer(schedule, weight_decay=args.weight_decay)
@@ -448,6 +469,11 @@ def main(argv: list[str] | None = None) -> None:
                         f"checkpoint's so dropout streams resume exactly"
                     )
                 args.seed = meta.rng_seed
+                if monitor is not None and meta.spike_monitor:
+                    # Resume the EMA loss baseline (follow-up b): the monitor
+                    # is armed immediately instead of sitting out a fresh
+                    # warmup window blind to spikes.
+                    monitor.load_state_dict(meta.spike_monitor)
                 if is_primary():
                     print(
                         f"resumed from {latest}: step {global_step}, epoch "
@@ -707,6 +733,9 @@ def main(argv: list[str] | None = None) -> None:
                                 batches_in_epoch=step_in_epoch,
                                 rng_seed=args.seed,
                                 total_tokens=tracker.total_tokens,
+                                spike_monitor=(
+                                    monitor.state_dict() if monitor else None
+                                ),
                             ),
                         )
                     if rollback_requested:
@@ -754,6 +783,9 @@ def main(argv: list[str] | None = None) -> None:
                                     batches_in_epoch=step_in_epoch,
                                     rng_seed=args.seed,
                                     total_tokens=tracker.total_tokens,
+                                    spike_monitor=(
+                                        monitor.state_dict() if monitor else None
+                                    ),
                                 ),
                             )
                         tracker.close()
@@ -836,6 +868,7 @@ def main(argv: list[str] | None = None) -> None:
                     batches_in_epoch=step_in_epoch,
                     rng_seed=args.seed,
                     total_tokens=tracker.total_tokens,
+                    spike_monitor=monitor.state_dict() if monitor else None,
                 ),
             )
         tracker.close()

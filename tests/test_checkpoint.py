@@ -73,6 +73,28 @@ def test_latest_checkpoint_empty(tmp_path):
     assert ckpt.latest_checkpoint(str(tmp_path / "nonexistent")) is None
 
 
+def test_meta_spike_monitor_roundtrip():
+    state = {"mean": 2.5, "var": 0.04, "n_healthy": 117}
+    meta = ckpt.CheckpointMeta(
+        step=7, epoch=1, batches_in_epoch=3, rng_seed=42, spike_monitor=state,
+    )
+    restored = ckpt.CheckpointMeta.from_json(meta.to_json())
+    assert restored == meta
+    assert restored.spike_monitor == state
+
+
+def test_meta_loads_legacy_json_without_spike_monitor():
+    """meta.json files written before the spike_monitor field must still
+    load (field defaults to None)."""
+    legacy = (
+        '{"step": 3, "epoch": 0, "batches_in_epoch": 3, '
+        '"rng_seed": 1, "total_tokens": 99}'
+    )
+    meta = ckpt.CheckpointMeta.from_json(legacy)
+    assert meta.step == 3 and meta.total_tokens == 99
+    assert meta.spike_monitor is None
+
+
 def test_sharded_restore_onto_mesh(tmp_path, tiny_config):
     """Save from an fsdp mesh, restore onto the same mesh: shardings and
     values both round-trip."""

@@ -1,0 +1,373 @@
+"""Fused layer-epilogue kernels (ops/fused_layer.py) vs the unfused ops.
+
+All kernel invocations run with ``interpret=True`` (forced implicitly: the
+suite pins JAX to CPU, and the entry points auto-select interpret off-TPU),
+so these tests exercise the real Pallas kernel bodies — block tiling, the
+salted counter-hash dropout streams, and the custom_vjp backward passes —
+without a chip. The acceptance bound from the issue is 1e-5 in fp32 for both
+forward outputs and gradients; the dropout-on cases compare against a
+reference built from ``epilogue_dropout_mask`` (the kernels hash absolute
+coordinates, so the full-width rehash reproduces every block's decisions).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpt_2_distributed_tpu.config import GPT2Config
+from gpt_2_distributed_tpu.models import gpt2
+from gpt_2_distributed_tpu.ops import fused_layer
+from gpt_2_distributed_tpu.ops.activations import gelu_tanh
+from gpt_2_distributed_tpu.ops.fused_layer import (
+    SALT_GELU,
+    SALT_LN_RESID,
+    SALT_RESID,
+    epilogue_dropout_mask,
+    fold_seed,
+    fused_bias_gelu_dropout,
+    fused_ln_residual_dropout,
+    fused_residual_dropout,
+)
+from gpt_2_distributed_tpu.ops.layers import layer_norm
+
+N, C, F = 64, 96, 192  # deliberately not 128-multiples: interpret-only tiling
+
+
+def _ops(rng_np, n=N, c=C, dtype=jnp.float32):
+    x = jnp.asarray(rng_np.normal(size=(n, c)) * 0.5, dtype)
+    o = jnp.asarray(rng_np.normal(size=(n, c)) * 0.5, dtype)
+    scale = jnp.asarray(1.0 + 0.1 * rng_np.normal(size=(c,)), dtype)
+    bias = jnp.asarray(0.1 * rng_np.normal(size=(c,)), dtype)
+    return x, o, scale, bias
+
+
+# ---------------------------------------------------------------------------
+# LN + residual + dropout
+# ---------------------------------------------------------------------------
+
+
+def test_ln_residual_fwd_fp32_matches_unfused(rng_np):
+    x, o, scale, bias = _ops(rng_np)
+    r, y = fused_ln_residual_dropout(x, o, scale, bias)
+    np.testing.assert_allclose(r, x + o, atol=1e-5, rtol=0)
+    np.testing.assert_allclose(
+        y, layer_norm(x + o, scale, bias), atol=1e-5, rtol=0
+    )
+
+
+def test_ln_residual_grads_fp32_match_unfused(rng_np):
+    x, o, scale, bias = _ops(rng_np)
+    wr = jnp.asarray(rng_np.normal(size=(N, C)), jnp.float32)
+    wy = jnp.asarray(rng_np.normal(size=(N, C)), jnp.float32)
+
+    def loss_fused(x, o, scale, bias):
+        r, y = fused_ln_residual_dropout(x, o, scale, bias)
+        return jnp.sum(r * wr) + jnp.sum(y * wy)
+
+    def loss_ref(x, o, scale, bias):
+        r = x + o
+        return jnp.sum(r * wr) + jnp.sum(layer_norm(r, scale, bias) * wy)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, o, scale, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, o, scale, bias)
+    for a, b, name in zip(gf, gr, ("dx", "do", "dscale", "dbias")):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=0, err_msg=name)
+
+
+def test_ln_residual_dropout_on_matches_mask_reference(rng_np):
+    x, o, scale, bias = _ops(rng_np)
+    rate = 0.3
+    rng = jax.random.PRNGKey(11)
+    r, y = fused_ln_residual_dropout(
+        x, o, scale, bias, rate=rate, rng=rng, deterministic=False
+    )
+    keep = epilogue_dropout_mask(fold_seed(rng), SALT_LN_RESID, (N, C), rate)
+    o_ref = jnp.where(keep, o / (1.0 - rate), 0.0)
+    np.testing.assert_allclose(r, x + o_ref, atol=1e-5, rtol=0)
+    np.testing.assert_allclose(
+        y, layer_norm(x + o_ref, scale, bias), atol=1e-5, rtol=0
+    )
+    # Dropped fraction lands near the nominal rate.
+    frac = 1.0 - float(jnp.mean(keep.astype(jnp.float32)))
+    assert abs(frac - rate) < 0.06
+
+
+def test_ln_residual_dropout_grads_match_mask_reference(rng_np):
+    x, o, scale, bias = _ops(rng_np)
+    rate = 0.2
+    rng = jax.random.PRNGKey(3)
+    keep = epilogue_dropout_mask(fold_seed(rng), SALT_LN_RESID, (N, C), rate)
+
+    def loss_fused(x, o, scale, bias):
+        r, y = fused_ln_residual_dropout(
+            x, o, scale, bias, rate=rate, rng=rng, deterministic=False
+        )
+        return jnp.sum(r * r) + jnp.sum(y**3)
+
+    def loss_ref(x, o, scale, bias):
+        r = x + jnp.where(keep, o / (1.0 - rate), 0.0)
+        return jnp.sum(r * r) + jnp.sum(layer_norm(r, scale, bias) ** 3)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, o, scale, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, o, scale, bias)
+    # rtol: the cubic loss amplifies gradient magnitudes to O(50), so a pure
+    # atol bound would test fp32 ulps, not the kernel.
+    for a, b, name in zip(gf, gr, ("dx", "do", "dscale", "dbias")):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5, err_msg=name)
+
+
+def test_ln_residual_bf16_tracks_unfused(rng_np):
+    x, o, scale, bias = _ops(rng_np, dtype=jnp.bfloat16)
+    r, y = fused_ln_residual_dropout(x, o, scale, bias)
+    assert r.dtype == jnp.bfloat16 and y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        r.astype(jnp.float32), (x + o).astype(jnp.float32), atol=0, rtol=0
+    )
+    # Both compute LN internals in fp32; outputs only differ by the final
+    # bf16 rounding of arithmetically-reassociated identical values.
+    y_ref = layer_norm(x + o, scale, bias).astype(jnp.float32)
+    np.testing.assert_allclose(y.astype(jnp.float32), y_ref, atol=0.04, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# residual + dropout
+# ---------------------------------------------------------------------------
+
+
+def test_residual_dropout_rate_zero_is_bare_add(rng_np):
+    x, o, _, _ = _ops(rng_np)
+    out = fused_residual_dropout(x, o)
+    np.testing.assert_array_equal(out, x + o)
+
+
+def test_residual_dropout_fwd_and_grads_match_mask_reference(rng_np):
+    x, o, _, _ = _ops(rng_np)
+    rate = 0.25
+    rng = jax.random.PRNGKey(5)
+    keep = epilogue_dropout_mask(fold_seed(rng), SALT_RESID, (N, C), rate)
+
+    def fused(x, o):
+        return fused_residual_dropout(
+            x, o, rate=rate, rng=rng, deterministic=False
+        )
+
+    def ref(x, o):
+        return x + jnp.where(keep, o / (1.0 - rate), 0.0)
+
+    np.testing.assert_allclose(fused(x, o), ref(x, o), atol=1e-5, rtol=0)
+    gf = jax.grad(lambda x, o: jnp.sum(fused(x, o) ** 2), argnums=(0, 1))(x, o)
+    gr = jax.grad(lambda x, o: jnp.sum(ref(x, o) ** 2), argnums=(0, 1))(x, o)
+    np.testing.assert_allclose(gf[0], gr[0], atol=1e-5, rtol=0)
+    np.testing.assert_allclose(gf[1], gr[1], atol=1e-5, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# bias + GELU + dropout
+# ---------------------------------------------------------------------------
+
+
+def test_bias_gelu_fwd_fp32_matches_unfused(rng_np):
+    h = jnp.asarray(rng_np.normal(size=(N, F)), jnp.float32)
+    b = jnp.asarray(0.1 * rng_np.normal(size=(F,)), jnp.float32)
+    out = fused_bias_gelu_dropout(h, b)
+    np.testing.assert_allclose(out, gelu_tanh(h + b), atol=1e-5, rtol=0)
+
+
+def test_bias_gelu_grads_fp32_match_unfused(rng_np):
+    h = jnp.asarray(rng_np.normal(size=(N, F)), jnp.float32)
+    b = jnp.asarray(0.1 * rng_np.normal(size=(F,)), jnp.float32)
+    w = jnp.asarray(rng_np.normal(size=(N, F)), jnp.float32)
+
+    gf = jax.grad(
+        lambda h, b: jnp.sum(fused_bias_gelu_dropout(h, b) * w),
+        argnums=(0, 1),
+    )(h, b)
+    gr = jax.grad(
+        lambda h, b: jnp.sum(gelu_tanh(h + b) * w), argnums=(0, 1)
+    )(h, b)
+    np.testing.assert_allclose(gf[0], gr[0], atol=1e-5, rtol=0, err_msg="dh")
+    np.testing.assert_allclose(gf[1], gr[1], atol=1e-5, rtol=0, err_msg="db")
+
+
+def test_bias_gelu_dropout_on_matches_mask_reference(rng_np):
+    h = jnp.asarray(rng_np.normal(size=(N, F)), jnp.float32)
+    b = jnp.asarray(0.1 * rng_np.normal(size=(F,)), jnp.float32)
+    rate = 0.1
+    rng = jax.random.PRNGKey(7)
+    out = fused_bias_gelu_dropout(
+        h, b, rate=rate, rng=rng, deterministic=False
+    )
+    keep = epilogue_dropout_mask(fold_seed(rng), SALT_GELU, (N, F), rate)
+    ref = jnp.where(keep, gelu_tanh(h + b) / (1.0 - rate), 0.0)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=0)
+
+
+def test_bias_gelu_bf16_tracks_unfused(rng_np):
+    h = jnp.asarray(rng_np.normal(size=(N, F)), jnp.bfloat16)
+    b = jnp.asarray(0.1 * rng_np.normal(size=(F,)), jnp.bfloat16)
+    out = fused_bias_gelu_dropout(h, b)
+    assert out.dtype == jnp.bfloat16
+    # The kernel computes the GELU in fp32 while the unfused gelu_tanh runs
+    # in bf16 throughout — tracking (one bf16 ulp of |out| <= ~|u|), not
+    # bitwise parity, is the contract here.
+    ref = gelu_tanh(h + b).astype(jnp.float32)
+    np.testing.assert_allclose(out.astype(jnp.float32), ref, atol=0.05, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# dropout stream determinism
+# ---------------------------------------------------------------------------
+
+
+def test_dropout_deterministic_per_key_and_salted_per_site(rng_np):
+    x, o, _, _ = _ops(rng_np)
+    rng = jax.random.PRNGKey(42)
+    kw = dict(rate=0.3, deterministic=False)
+    a = fused_residual_dropout(x, o, rng=rng, **kw)
+    b = fused_residual_dropout(x, o, rng=rng, **kw)
+    np.testing.assert_array_equal(a, b)  # same key -> identical mask
+    c = fused_residual_dropout(x, o, rng=jax.random.PRNGKey(43), **kw)
+    assert not bool(jnp.array_equal(a, c))  # different key -> different mask
+    # Different salts (= different fusion sites) decorrelate even on the
+    # same key: the LN-junction stream must not reuse the resid stream.
+    seed = fold_seed(rng)
+    m1 = epilogue_dropout_mask(seed, SALT_RESID, (N, C), 0.3)
+    m2 = epilogue_dropout_mask(seed, SALT_LN_RESID, (N, C), 0.3)
+    assert not bool(jnp.array_equal(m1, m2))
+
+
+def test_block_tiling_invariant(rng_np):
+    """The mask hashes absolute coordinates, so the kernel's output cannot
+    depend on which block size _pick_block_rows chose."""
+    x, o, scale, bias = _ops(rng_np, n=32)
+    rng = jax.random.PRNGKey(9)
+    outs = []
+    for bn in (32, 8, 1):
+        fn = fused_layer._build_ln_res_drop(0.3, 1e-5, bn, C, SALT_LN_RESID, True)
+        r, y = fn(x, o, scale, bias, fold_seed(rng))
+        outs.append((r, y))
+    for r, y in outs[1:]:
+        np.testing.assert_allclose(r, outs[0][0], atol=1e-6, rtol=0)
+        np.testing.assert_allclose(y, outs[0][1], atol=1e-6, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# sharded path: shard_map over the batch-like mesh axes
+# ---------------------------------------------------------------------------
+
+
+def test_fused_under_data_mesh_matches_unfused(rng_np):
+    """An active data mesh routes through the shard_map wrapper (the compat
+    shim matters: the pinned jax only has the experimental shard_map); the
+    deterministic outputs must still match the unfused reference exactly."""
+    from gpt_2_distributed_tpu.parallel.mesh import (
+        MeshSpec, activate_mesh, create_mesh,
+    )
+
+    mesh = create_mesh(MeshSpec(data=4, fsdp=1))
+    b, t = 8, 16
+    x = jnp.asarray(rng_np.normal(size=(b, t, C)) * 0.5, jnp.float32)
+    o = jnp.asarray(rng_np.normal(size=(b, t, C)) * 0.5, jnp.float32)
+    scale = jnp.asarray(1.0 + 0.1 * rng_np.normal(size=(C,)), jnp.float32)
+    bias = jnp.asarray(0.1 * rng_np.normal(size=(C,)), jnp.float32)
+    with activate_mesh(mesh):
+        r, y = fused_ln_residual_dropout(x, o, scale, bias)
+    np.testing.assert_allclose(r, x + o, atol=1e-5, rtol=0)
+    np.testing.assert_allclose(
+        y, layer_norm(x + o, scale, bias), atol=1e-5, rtol=0
+    )
+
+
+def test_fused_dropout_under_mesh_deterministic_and_decorrelated(rng_np):
+    """Sharded dropout: per-shard seed mixing keeps streams deterministic per
+    key while distinct across shards (no two shard-rows reuse a mask)."""
+    from gpt_2_distributed_tpu.parallel.mesh import (
+        MeshSpec, activate_mesh, create_mesh,
+    )
+
+    mesh = create_mesh(MeshSpec(data=4, fsdp=1))
+    b, t, rate = 8, 16, 0.4
+    x = jnp.zeros((b, t, C), jnp.float32)
+    o = jnp.ones((b, t, C), jnp.float32)
+    rng = jax.random.PRNGKey(21)
+    with activate_mesh(mesh):
+        a1 = fused_residual_dropout(x, o, rate=rate, rng=rng, deterministic=False)
+        a2 = fused_residual_dropout(x, o, rate=rate, rng=rng, deterministic=False)
+    np.testing.assert_array_equal(a1, a2)
+    # x=0, o=1: kept entries read 1/(1-rate), dropped read 0.
+    frac = float(jnp.mean((np.asarray(a1) == 0.0).astype(np.float32)))
+    assert abs(frac - rate) < 0.05
+    kept = np.asarray(a1)[np.asarray(a1) != 0.0]
+    np.testing.assert_allclose(kept, 1.0 / (1.0 - rate), atol=1e-6)
+    # Shard-local coordinates are identical on every shard — the mixed-in
+    # shard index is what must decorrelate the masks. Two shard-sized row
+    # groups sharing a mask would show as identical zero patterns.
+    zeros = (np.asarray(a1).reshape(b, -1) == 0.0)
+    per_shard = zeros.reshape(4, -1)
+    assert not any(
+        np.array_equal(per_shard[i], per_shard[j])
+        for i in range(4) for j in range(i + 1, 4)
+    )
+
+
+# ---------------------------------------------------------------------------
+# model-level parity: fused_layers="all" vs "off"
+# ---------------------------------------------------------------------------
+
+
+def _batch(config, rng_np, b=2, t=32):
+    x = rng_np.integers(0, config.vocab_size, (b, t)).astype(np.int32)
+    y = rng_np.integers(0, config.vocab_size, (b, t)).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+@pytest.mark.parametrize("remat", [False, "mlp"])
+def test_model_fused_all_matches_off_fp32(tiny_config, rng_np, scan_layers, remat):
+    params = gpt2.init_params(tiny_config)
+    x, y = _batch(tiny_config, rng_np)
+    base = tiny_config.replace(scan_layers=scan_layers, remat=remat)
+
+    def loss_for(cfg):
+        return lambda p: gpt2.forward(
+            p, cfg, x, labels=y, compute_dtype=jnp.float32
+        )[1]
+
+    l_off, g_off = jax.value_and_grad(loss_for(base))(params)
+    l_all, g_all = jax.value_and_grad(
+        loss_for(base.replace(fused_layers="all"))
+    )(params)
+    assert abs(float(l_all) - float(l_off)) < 1e-5
+    jax.tree_util.tree_map_with_path(
+        lambda path, a, b: np.testing.assert_allclose(
+            a, b, atol=1e-5, rtol=0, err_msg=jax.tree_util.keystr(path)
+        ),
+        g_all, g_off,
+    )
+
+
+def test_model_fused_training_mode_finite(tiny_config, rng_np):
+    """Dropout active (deterministic=False): fused paths diverge numerically
+    from unfused (different hash streams) but must stay finite with live
+    gradients everywhere."""
+    cfg = tiny_config.replace(
+        fused_layers="all", resid_dropout=0.1, scan_layers=False
+    )
+    params = gpt2.init_params(cfg)
+    x, y = _batch(cfg, rng_np)
+    loss, grads = jax.value_and_grad(
+        lambda p: gpt2.forward(
+            p, cfg, x, labels=y, compute_dtype=jnp.float32,
+            rng=jax.random.PRNGKey(0), deterministic=False,
+        )[1]
+    )(params)
+    assert jnp.isfinite(loss)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in leaves)
+    assert any(float(jnp.max(jnp.abs(g))) > 0 for g in leaves)
+
+
+def test_config_rejects_bad_fused_layers():
+    with pytest.raises(ValueError, match="fused_layers"):
+        GPT2Config(fused_layers="both")

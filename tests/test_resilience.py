@@ -158,6 +158,47 @@ def test_spike_monitor_reset():
     assert mon.observe(1.0, skipped=True) == "anomaly"  # not rollback
 
 
+def test_spike_monitor_state_roundtrip():
+    """state_dict/load_state_dict carry the EMA baseline across a resume:
+    a restored monitor must flag the same spike a continuously-run one
+    would, with no fresh warmup window."""
+    mon = SpikeMonitor(sigma=6.0, warmup=10)
+    for _ in range(25):
+        mon.observe(1.0)
+    state = mon.state_dict()
+    assert set(state) == {"mean", "var", "n_healthy"}
+
+    fresh = SpikeMonitor(sigma=6.0, warmup=10)
+    fresh.load_state_dict(state)
+    assert fresh.mean == pytest.approx(mon.mean)
+    assert fresh.var == pytest.approx(mon.var)
+    assert fresh.n_healthy == mon.n_healthy
+    # Past warmup immediately: the restored baseline catches the spike a
+    # fresh monitor would have swallowed as warmup.
+    assert fresh.observe(50.0) == "anomaly"
+    # JSON-safe: meta.json round-trips it through json.dumps.
+    import json
+
+    assert json.loads(json.dumps(state)) == state
+
+
+def test_spike_monitor_state_excludes_consecutive():
+    """`consecutive` counts skips within one process's run of bad steps; a
+    resume starts a new run, so load_state_dict must zero it even if a stale
+    value sneaks into the dict."""
+    mon = SpikeMonitor(max_consecutive=2)
+    for _ in range(30):
+        mon.observe(1.0)
+    mon.observe(100.0, skipped=True)
+    assert mon.consecutive == 1
+    state = mon.state_dict()
+    assert "consecutive" not in state
+
+    fresh = SpikeMonitor(max_consecutive=2)
+    fresh.load_state_dict({**state, "consecutive": 5})
+    assert fresh.consecutive == 0
+
+
 # --- layer 3: manifest + verification ---------------------------------------
 
 

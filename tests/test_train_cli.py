@@ -185,3 +185,54 @@ def test_cli_explicit_mesh(capsys, shard_dir):
         "--cli_every", "1",
     )
     assert "mesh: data=2, fsdp=4" in out
+
+
+def test_cli_fused_layers_trains(capsys, shard_dir):
+    """--fused_layers all: the fused Pallas epilogues (interpret mode on CPU)
+    run through the whole train loop and the loss still descends."""
+    out = run_cli(
+        capsys,
+        "--data_dir", shard_dir,
+        "--n_layer", "2",
+        "--n_embd", "32",
+        "--n_head", "2",
+        "--vocab_size", "257",
+        "--seq_len", "32",
+        "--batch", "4",
+        "--grad_accum_steps", "1",
+        "--max_steps", "6",
+        "--lr", "3e-3",
+        "--cli_every", "1",
+        "--fused_layers", "all",
+    )
+    losses = losses_from(out)
+    assert losses and losses[-1] < losses[0], out
+    assert "training done: 6 optimizer steps" in out
+
+
+# --- operating-point warnings (utils/operating_point.py) ---------------------
+
+
+def test_accum_cliff_message_exact_match_only():
+    from gpt_2_distributed_tpu.utils.operating_point import accum_cliff_message
+
+    msg = accum_cliff_message(1024, 16, scan_layers=False)
+    assert msg is not None
+    assert "grad_accum_steps=16" in msg and "PERF_ANALYSIS.md" in msg
+    # The scan path compiles the accumulation loop differently — no cliff.
+    assert accum_cliff_message(1024, 16, scan_layers=True) is None
+    # Neighboring operating points measured fine; exact-match only.
+    assert accum_cliff_message(1024, 12, scan_layers=False) is None
+    assert accum_cliff_message(2048, 16, scan_layers=False) is None
+
+
+def test_warn_once_dedupes_per_tag():
+    from gpt_2_distributed_tpu.utils import operating_point as op
+
+    seen = []
+    op._WARNED.discard("t1")
+    op._WARNED.discard("t2")
+    assert op.warn_once("t1", "first", printer=seen.append) is True
+    assert op.warn_once("t1", "first again", printer=seen.append) is False
+    assert op.warn_once("t2", "second", printer=seen.append) is True
+    assert seen == ["warning: first", "warning: second"]
