@@ -134,6 +134,24 @@ def main() -> None:
         "dropout epilogue, 'all' = both. Default off until the marginal "
         "microbench (scripts/bench_fused.py) confirms the win on-chip",
     )
+    p.add_argument(
+        "--ckpt_every", type=int, default=0,
+        help="save a real checkpoint every N measured steps (0 = off) and "
+        "record the step-loop stall each save cost (ckpt_block_ms_*) — the "
+        "direct measurement of what async checkpointing buys: compare "
+        "--ckpt_async on vs off on the same config",
+    )
+    p.add_argument(
+        "--ckpt_async", default="on", choices=["on", "off"],
+        help="checkpoint mode for --ckpt_every: 'on' = non-blocking "
+        "CheckpointSaver pipeline (commit in the background), 'off' = fully "
+        "synchronous saves",
+    )
+    p.add_argument(
+        "--ckpt_dir", default=None,
+        help="where --ckpt_every writes (default: a fresh temp dir, removed "
+        "after the run)",
+    )
     args = p.parse_args()
     args.steps = max(1, args.steps)
     args.warmup = max(1, args.warmup)  # first call doubles as the compile step
@@ -152,6 +170,7 @@ def main() -> None:
                 ("--accum_dtype", args.accum_dtype != "auto"),
                 ("--loss_block_rows", args.loss_block_rows),
                 ("--fused_layers", args.fused_layers != "off"),
+                ("--ckpt_every", args.ckpt_every),
             ) if hit
         ]
         if overrides:
@@ -261,6 +280,9 @@ def run_config_resilient(args, model: str, seq_len: int) -> dict:
         cmd += ["--scan_layers", args.scan_layers]
     if getattr(args, "fused_layers", "off") != "off":
         cmd += ["--fused_layers", args.fused_layers]
+    if getattr(args, "ckpt_every", 0):
+        cmd += ["--ckpt_every", str(args.ckpt_every),
+                "--ckpt_async", getattr(args, "ckpt_async", "on")]
     errors = []
     for attempt in (1, 2):
         try:
@@ -455,6 +477,30 @@ def run_config(args, model: str, seq_len: int) -> dict:
         x, y = shard_batch((x, y), mesh)
         key = jax.random.PRNGKey(0)
 
+        # --ckpt_every: real CheckpointSaver saves inside the measured loop,
+        # so the record captures the step-loop stall checkpointing costs at
+        # this exact operating point (the number async mode exists to shrink).
+        saver = None
+        ckpt_block_ms: list[float] = []
+        ckpt_tmp_dir = None
+        if getattr(args, "ckpt_every", 0):
+            import shutil
+            import tempfile
+
+            from gpt_2_distributed_tpu import checkpoint as ckpt_mod
+            from gpt_2_distributed_tpu.config import CheckpointPolicy
+
+            ckpt_dir = getattr(args, "ckpt_dir", None)
+            if not ckpt_dir:
+                ckpt_dir = ckpt_tmp_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+            saver = ckpt_mod.CheckpointSaver(
+                ckpt_dir,
+                CheckpointPolicy(
+                    async_save=getattr(args, "ckpt_async", "on") == "on",
+                    keep_last_n=2,  # bound the bench's disk footprint
+                ),
+            )
+
         for i in range(args.warmup):
             params, opt_state, metrics = step(params, opt_state, x, y, key, i)
         float(metrics.loss)  # materialize: full sync with the device
@@ -464,12 +510,31 @@ def run_config(args, model: str, seq_len: int) -> dict:
             params, opt_state, metrics = step(
                 params, opt_state, x, y, key, args.warmup + i
             )
+            if saver is not None and (i + 1) % args.ckpt_every == 0:
+                saver.save(
+                    i + 1, params, opt_state,
+                    ckpt_mod.CheckpointMeta(
+                        step=i + 1, epoch=0, batches_in_epoch=i + 1,
+                        rng_seed=0,
+                    ),
+                )
+                ckpt_block_ms.append(saver.save_block_ms)
         # float() forces a device->host read of the last loss, which transitively
         # depends on every step in the loop (next step's loss needs this step's
         # params) — a plain block_until_ready proved unreliable through remote
         # TPU tunnels.
         final_loss = float(metrics.loss)
         dt = time.perf_counter() - t0
+        ckpt_drain_ms = None
+        if saver is not None:
+            # Background commits still running after the loop are real work
+            # the run pays eventually — measured separately from dt, which is
+            # exactly the point: the step loop didn't wait for them.
+            t_drain = time.perf_counter()
+            saver.close()
+            ckpt_drain_ms = (time.perf_counter() - t_drain) * 1e3
+            if ckpt_tmp_dir:
+                shutil.rmtree(ckpt_tmp_dir, ignore_errors=True)
 
     tokens_per_step = grad_accum * micro_batch * n_chips * seq_len
     tok_s = tokens_per_step * steps / dt
@@ -477,9 +542,26 @@ def run_config(args, model: str, seq_len: int) -> dict:
     peak = device_peak_flops()
     measured_mfu = mfu(tok_s_chip, config, seq_len, peak)
 
+    record_extra = {}
+    if saver is not None:
+        record_extra = {
+            "ckpt_every": args.ckpt_every,
+            "ckpt_async": getattr(args, "ckpt_async", "on") == "on",
+            "ckpt_saves": len(ckpt_block_ms),
+            "ckpt_failed_saves": saver.failed_saves,
+            "ckpt_block_ms_mean": (
+                round(float(np.mean(ckpt_block_ms)), 2) if ckpt_block_ms else None
+            ),
+            "ckpt_block_ms_max": (
+                round(float(np.max(ckpt_block_ms)), 2) if ckpt_block_ms else None
+            ),
+            "ckpt_drain_ms": round(ckpt_drain_ms, 2),
+        }
+
     return {
         "metric": "tokens_per_sec_per_chip",
         "value": round(tok_s_chip, 1),
+        **record_extra,
         "unit": "tok/s/chip",
         "vs_baseline": round(measured_mfu / 0.50, 4) if measured_mfu else None,
         "mfu": round(measured_mfu, 4) if measured_mfu else None,

@@ -20,6 +20,22 @@ This module is the from-scratch replacement, TPU-native:
 * **Reference layout kept**: ``{save_dir}/step_{step:07d}/`` directories
   (``/root/reference/train_gpt2_distributed.py:77``), ``meta.json`` alongside
   the orbax trees.
+* **Commit protocol** (the async-pipeline contract): every save writes a
+  ``.INPROGRESS`` marker first and a ``COMMITTED`` sentinel last (tmp + fsync
+  + atomic rename, after ``manifest.json`` is built and read-back-verified).
+  A directory carrying ``.INPROGRESS`` without ``COMMITTED`` is an
+  interrupted/failed save: ``list_checkpoints``/``latest_checkpoint``/
+  ``restore_latest_verified`` never surface it and :func:`gc_checkpoints`
+  prunes it. Directories with neither marker are legacy (pre-sentinel)
+  checkpoints and stay trusted exactly as before (manifest/structural
+  verification at restore).
+* **Non-blocking saves**: :class:`CheckpointSaver` snapshots device arrays
+  (the blocking device->host copy orbax's ``AsyncCheckpointer`` performs
+  inside ``save``) and returns to the step loop immediately; a background
+  commit thread waits out the sharded write, builds + verifies the manifest,
+  writes ``COMMITTED``, and runs retention GC. Transient failures retry with
+  exponential backoff; exhausted retries degrade to a warning + the
+  ``save_failures`` metric instead of killing the run.
 """
 
 from __future__ import annotations
@@ -27,16 +43,32 @@ from __future__ import annotations
 import json
 import os
 import re
+import shutil
+import threading
+import time
 from dataclasses import asdict, dataclass
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
 from gpt_2_distributed_tpu import resilience
+from gpt_2_distributed_tpu.config import CheckpointPolicy
 
 STEP_DIR_RE = re.compile(r"^step_(\d{7,})$")
+
+# Commit-protocol marker files (see module docstring). COMMITTED is written
+# LAST and atomically; .INPROGRESS is written FIRST — their combination
+# classifies every step dir as committed / uncommitted / legacy.
+COMMITTED_NAME = "COMMITTED"
+INPROGRESS_NAME = ".INPROGRESS"
+
+# Test seam: sleep this many seconds in the async commit thread between the
+# array write finishing and the commit (manifest + COMMITTED) starting —
+# lets a CPU e2e test prove deterministically that training steps proceed
+# while a checkpoint is still uncommitted.
+COMMIT_DELAY_ENV = "GPT2_TPU_INJECT_COMMIT_DELAY_S"
 
 
 def step_dir_name(step: int) -> str:
@@ -66,6 +98,81 @@ class CheckpointMeta:
         return cls(**json.loads(text))
 
 
+def _dir_state(path: str) -> str:
+    """Commit-protocol classification of one step dir.
+
+    ``"committed"`` — the COMMITTED sentinel exists (write + manifest +
+    verification all finished); ``"uncommitted"`` — an .INPROGRESS marker
+    without COMMITTED (the save was interrupted or failed: never trust it);
+    ``"legacy"`` — neither marker, i.e. a checkpoint written before the
+    commit protocol existed (trusted exactly as before: manifest/structural
+    verification decides at restore time).
+    """
+    if os.path.exists(os.path.join(path, COMMITTED_NAME)):
+        return "committed"
+    if os.path.exists(os.path.join(path, INPROGRESS_NAME)):
+        return "uncommitted"
+    return "legacy"
+
+
+def is_committed_checkpoint(path: str) -> bool:
+    """True when ``path`` holds a checkpoint restore may surface: committed,
+    or legacy-with-meta (pre-protocol dirs have no sentinel to check)."""
+    state = _dir_state(path)
+    if state == "uncommitted":
+        return False
+    return os.path.exists(os.path.join(path, "meta.json"))
+
+
+def _mark_inprogress(path: str) -> None:
+    """Open a save transaction on ``path``: drop a stale COMMITTED (re-saving
+    over an existing dir un-commits it until the new commit lands) and write
+    the .INPROGRESS marker FIRST, before any array bytes."""
+    os.makedirs(path, exist_ok=True)
+    if jax.process_index() != 0:
+        return
+    committed = os.path.join(path, COMMITTED_NAME)
+    if os.path.exists(committed):
+        os.remove(committed)
+    with open(os.path.join(path, INPROGRESS_NAME), "w") as f:
+        f.write(f"{time.time():.3f}\n")
+
+
+def _commit_files(
+    path: str, step: int, meta: CheckpointMeta, verify: bool = False
+) -> None:
+    """The commit stage: meta.json -> manifest (sizes + CRC32C) -> optional
+    read-back verification -> COMMITTED sentinel (tmp + fsync + atomic
+    rename) -> clear .INPROGRESS. Process 0 only (single writer); raises on
+    any failure so the caller's retry policy can engage — the sentinel is
+    written only when everything before it succeeded.
+    """
+    if jax.process_index() != 0:
+        return
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        f.write(meta.to_json())
+    resilience.write_manifest(path, step)
+    if verify:
+        # Read-back verification: re-hash what was just written. Catches a
+        # torn/short write between the array write finishing and the commit —
+        # the window an async pipeline widens from microseconds to seconds.
+        problems = resilience.verify_checkpoint(path)
+        if problems:
+            raise RuntimeError(
+                "post-write verification failed: " + "; ".join(problems)
+            )
+    target = os.path.join(path, COMMITTED_NAME)
+    tmp = target + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"step": int(step), "committed_at": time.time()}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, target)
+    inprogress = os.path.join(path, INPROGRESS_NAME)
+    if os.path.exists(inprogress):
+        os.remove(inprogress)
+
+
 def save_checkpoint(
     save_dir: str,
     step: int,
@@ -73,9 +180,15 @@ def save_checkpoint(
     opt_state: Any,
     meta: CheckpointMeta,
 ) -> str:
-    """Write one checkpoint; all processes participate (collective). Returns
-    the checkpoint directory path."""
+    """Write + commit one checkpoint synchronously; all processes participate
+    (collective). Returns the checkpoint directory path.
+
+    This is the simple blocking path (tests, export tooling). The training
+    driver uses :class:`CheckpointSaver`, which adds async writes, retries,
+    and retention GC on top of the same commit protocol.
+    """
     path = os.path.join(os.path.abspath(save_dir), step_dir_name(step))
+    _mark_inprogress(path)
     # force=True: re-saving the same step (final save landing on a periodic
     # save's step, or retrying over a partial dir left by a crash) overwrites
     # instead of raising — saves must be idempotent for resume to be robust.
@@ -83,34 +196,84 @@ def save_checkpoint(
         ckptr.save(os.path.join(path, "params"), params, force=True)
         ckptr.save(os.path.join(path, "opt_state"), opt_state, force=True)
     # StandardCheckpointer.save is async-capable; the context-manager exit
-    # above waits for completion, so meta.json lands only after the arrays.
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            f.write(meta.to_json())
-        # manifest.json is the atomic commit point (tmp + fsync + rename):
-        # it records sizes + CRC32C over everything above, so a checkpoint
-        # without a valid manifest is either legacy (pre-manifest) or was
-        # interrupted mid-save — restore_latest_verified tells them apart.
-        resilience.write_manifest(path, step)
+    # above waits for completion, so the commit files land only after the
+    # arrays are fully on disk.
+    _commit_files(path, step, meta)
     return path
 
 
-def list_checkpoints(save_dir: str) -> list[tuple[int, str]]:
-    """(step, path) for every complete checkpoint under save_dir, ascending."""
+def list_checkpoints(
+    save_dir: str, committed_only: bool = True
+) -> list[tuple[int, str]]:
+    """(step, path) for every complete checkpoint under save_dir, ascending.
+
+    ``committed_only`` (default) hides uncommitted dirs — saves that were
+    interrupted between write and commit; legacy pre-protocol dirs are
+    always included (their verification happens at restore).
+    """
     if not os.path.isdir(save_dir):
         return []
     out = []
     for name in os.listdir(save_dir):
         m = STEP_DIR_RE.match(name)
         path = os.path.join(save_dir, name)
-        if m and os.path.exists(os.path.join(path, "meta.json")):
-            out.append((int(m.group(1)), path))
+        if not (m and os.path.exists(os.path.join(path, "meta.json"))):
+            continue
+        if committed_only and _dir_state(path) == "uncommitted":
+            continue
+        out.append((int(m.group(1)), path))
     return sorted(out)
+
+
+def list_uncommitted(save_dir: str) -> list[str]:
+    """Step dirs whose save never committed (.INPROGRESS without COMMITTED) —
+    with or without a meta.json: a crash can land anywhere in the write."""
+    if not os.path.isdir(save_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(save_dir)):
+        path = os.path.join(save_dir, name)
+        if STEP_DIR_RE.match(name) and os.path.isdir(path):
+            if _dir_state(path) == "uncommitted":
+                out.append(path)
+    return out
 
 
 def latest_checkpoint(save_dir: str) -> str | None:
     ckpts = list_checkpoints(save_dir)
     return ckpts[-1][1] if ckpts else None
+
+
+def gc_checkpoints(
+    save_dir: str,
+    keep_last_n: int = 0,
+    protect: frozenset[str] | set[str] = frozenset(),
+) -> list[str]:
+    """Retention GC; returns the removed paths (process 0 acts, others no-op).
+
+    Always prunes uncommitted dirs (interrupted/failed saves — restore never
+    surfaces them, so they are pure disk waste). When ``keep_last_n > 0``,
+    additionally deletes all but the newest ``keep_last_n`` *committed*
+    checkpoints — the newest committed checkpoint is therefore never deleted
+    (``ckpts[:-n]`` with n >= 1 always spares it). ``protect`` paths (e.g. an
+    in-flight save dir) are skipped unconditionally.
+    """
+    if jax.process_index() != 0:
+        return []
+    protect = {os.path.abspath(p) for p in protect}
+    removed: list[str] = []
+    for path in list_uncommitted(save_dir):
+        if os.path.abspath(path) in protect:
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    if keep_last_n > 0:
+        for _step, path in list_checkpoints(save_dir)[:-keep_last_n]:
+            if os.path.abspath(path) in protect:
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+    return removed
 
 
 def restore_latest_verified(
@@ -132,6 +295,13 @@ def restore_latest_verified(
     logged on process 0. Returns ``(params, opt_state, meta, path)``, or
     None when no checkpoint survives.
     """
+    if jax.process_index() == 0:
+        for path in list_uncommitted(save_dir):
+            print(
+                f"[resilience] skipping uncommitted checkpoint {path} "
+                f"(no {COMMITTED_NAME} sentinel — save was interrupted or "
+                f"failed before commit)"
+            )
     candidates = list(reversed(list_checkpoints(save_dir)))
     for i, (step, path) in enumerate(candidates):
         problems = resilience.verify_checkpoint(path)
@@ -290,6 +460,224 @@ def restore_params(
             params_template, param_shardings,
         )
     return params, meta
+
+
+class CheckpointSaver:
+    """Checkpoint lifecycle driver: async writes, commit, retries, GC.
+
+    The step loop calls :meth:`save`; in async mode it blocks only for the
+    device->host snapshot (orbax ``AsyncCheckpointer.save`` copies to host
+    before returning — mandatory here because ``train_step`` donates the
+    params/opt_state buffers, which the very next step overwrites) and the
+    sharded OCDBT write + manifest + verification + COMMITTED sentinel all
+    happen on a background commit thread. Two checkpointers (params,
+    opt_state) so the second ``save`` call doesn't serialize behind the
+    first's background write.
+
+    Failure policy: initiation failures (the synchronous snapshot) and
+    commit-stage failures retry with exponential backoff per
+    ``CheckpointPolicy``; a background *write* failure cannot retry (the
+    donated source buffers are long gone), so it — like exhausted retries —
+    degrades to ``failed_saves`` + a warning, leaving an uncommitted dir
+    that restore skips and GC prunes. A save failure never crashes training.
+    """
+
+    def __init__(self, save_dir: str, policy: CheckpointPolicy | None = None):
+        self.save_dir = os.path.abspath(save_dir)
+        self.policy = policy or CheckpointPolicy()
+        self.failed_saves = 0          # saves that never committed
+        self.committed_steps: list[int] = []
+        self.last_error: str | None = None
+        self.save_block_ms = 0.0       # step-loop stall of the last save()
+        # Fault injection (tests / --inject_save_fail_at): the first
+        # `inject_fail_count` attempts of save step == `inject_fail_at` raise.
+        self.inject_fail_at = 0
+        self.inject_fail_count = 0
+        # Test seam: called in the commit thread after the array write
+        # completes, before commit files are written (e.g. a threading.Event
+        # wait, to hold a checkpoint in the uncommitted state on purpose).
+        self.pre_commit_hook: Callable[[str], None] | None = None
+        self._commit_thread: threading.Thread | None = None
+        self._ckptrs = None
+        if self.policy.async_save:
+            self._ckptrs = (
+                ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler()),
+                ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler()),
+            )
+
+    # ---- fault injection ------------------------------------------------
+
+    def _maybe_inject(self, step: int) -> None:
+        if self.inject_fail_count > 0 and step == self.inject_fail_at:
+            self.inject_fail_count -= 1
+            raise IOError(f"injected save failure (step {step})")
+
+    # ---- retry loop -----------------------------------------------------
+
+    def _with_retries(self, step: int, what: str, fn: Callable[[], Any]) -> bool:
+        """Run ``fn`` with the policy's retry/backoff; True on success.
+        Permanent failure records ``failed_saves`` and warns — never raises."""
+        delay = self.policy.retry_backoff_s
+        for attempt in range(self.policy.save_retries + 1):
+            try:
+                self._maybe_inject(step)
+                fn()
+                return True
+            except Exception as exc:
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                if attempt < self.policy.save_retries:
+                    if jax.process_index() == 0:
+                        print(
+                            f"[ckpt] {what} failed (attempt {attempt + 1}/"
+                            f"{self.policy.save_retries + 1}): "
+                            f"{self.last_error}; retrying in {delay:.2f}s"
+                        )
+                    time.sleep(delay)
+                    delay *= 2
+        self.failed_saves += 1
+        if jax.process_index() == 0:
+            print(
+                f"[ckpt] WARNING: {what} failed permanently after "
+                f"{self.policy.save_retries + 1} attempts "
+                f"({self.last_error}); training continues without this "
+                f"checkpoint"
+            )
+        return False
+
+    # ---- save paths -----------------------------------------------------
+
+    def save(self, step: int, params: Any, opt_state: Any,
+             meta: CheckpointMeta) -> str | None:
+        """Save one checkpoint per the policy. Async: snapshot + kick off the
+        write, commit in the background, return immediately. Sync: write +
+        commit before returning. Returns the step dir (None on permanent
+        initiation failure)."""
+        t0 = time.perf_counter()
+        path = os.path.join(self.save_dir, step_dir_name(step))
+        try:
+            if not self.policy.async_save:
+                ok = self._with_retries(
+                    step, f"save {step_dir_name(step)}",
+                    lambda: self._save_and_commit_sync(path, step, params,
+                                                       opt_state, meta),
+                )
+                return path if ok else None
+
+            # One in-flight save at a time: a previous commit still running
+            # means its background write may also still be running — orbax
+            # would block the new save on it anyway, and overlapping commit
+            # threads could interleave GC with an in-flight write.
+            self.wait()
+
+            def initiate() -> None:
+                _mark_inprogress(path)
+                pc, oc = self._ckptrs
+                pc.save(os.path.join(path, "params"),
+                        args=ocp.args.StandardSave(params), force=True)
+                oc.save(os.path.join(path, "opt_state"),
+                        args=ocp.args.StandardSave(opt_state), force=True)
+
+            ok = self._with_retries(
+                step, f"async save initiation {step_dir_name(step)}", initiate
+            )
+            if not ok:
+                return None
+            if jax.process_index() == 0:
+                print(f"[ckpt] async save initiated ({step_dir_name(step)})")
+            self._commit_thread = threading.Thread(
+                target=self._commit_async, args=(path, step, meta),
+                name=f"ckpt-commit-{step}", daemon=True,
+            )
+            self._commit_thread.start()
+            return path
+        finally:
+            self.save_block_ms = (time.perf_counter() - t0) * 1e3
+
+    def _save_and_commit_sync(self, path: str, step: int, params: Any,
+                              opt_state: Any, meta: CheckpointMeta) -> None:
+        _mark_inprogress(path)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(path, "params"), params, force=True)
+            ckptr.save(os.path.join(path, "opt_state"), opt_state, force=True)
+        _commit_files(path, step, meta, verify=True)
+        self._after_commit(path, step)
+
+    def _commit_async(self, path: str, step: int,
+                      meta: CheckpointMeta) -> None:
+        """Background stage: wait out the sharded write, then commit + GC."""
+        try:
+            for c in self._ckptrs:
+                c.wait_until_finished()
+        except Exception as exc:
+            # The write itself failed after the source buffers were donated
+            # away — nothing left to retry from. Leave the dir uncommitted
+            # (restore skips it, GC prunes it) and record the failure.
+            self.failed_saves += 1
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            if jax.process_index() == 0:
+                print(
+                    f"[ckpt] WARNING: background write for "
+                    f"{os.path.basename(path)} failed ({self.last_error}); "
+                    f"dir left uncommitted"
+                )
+            return
+        delay_s = float(os.environ.get(COMMIT_DELAY_ENV, "0") or 0)
+        if delay_s > 0:
+            time.sleep(delay_s)
+        if self.pre_commit_hook is not None:
+            self.pre_commit_hook(path)
+        ok = self._with_retries(
+            step, f"commit {os.path.basename(path)}",
+            lambda: _commit_files(path, step, meta, verify=True),
+        )
+        if ok:
+            self._after_commit(path, step)
+
+    def _after_commit(self, path: str, step: int) -> None:
+        self.committed_steps.append(step)
+        if jax.process_index() == 0:
+            print(f"[ckpt] committed {os.path.basename(path)}")
+        removed = gc_checkpoints(
+            self.save_dir, self.policy.keep_last_n, protect={path}
+        )
+        if removed and jax.process_index() == 0:
+            names = ", ".join(os.path.basename(p) for p in removed)
+            print(f"[ckpt] gc removed {names}")
+
+    # ---- draining / emergency ------------------------------------------
+
+    def wait(self, timeout: float | None = None) -> None:
+        """Block until the in-flight async save (if any) fully commits."""
+        t = self._commit_thread
+        if t is not None:
+            t.join(timeout)
+            if not t.is_alive():
+                self._commit_thread = None
+
+    def ensure_committed_sync(self, step: int, params: Any, opt_state: Any,
+                              meta: CheckpointMeta) -> str | None:
+        """Emergency/final save: guarantee a committed checkpoint for ``step``
+        before returning, without ever racing an in-flight async save on the
+        same dir (wait-or-supersede: the in-flight save is drained first; if
+        it already committed this exact step, done — otherwise write
+        synchronously over/next to it)."""
+        self.wait()
+        path = os.path.join(self.save_dir, step_dir_name(step))
+        if step in self.committed_steps and is_committed_checkpoint(path):
+            return path
+        ok = self._with_retries(
+            step, f"emergency save {step_dir_name(step)}",
+            lambda: self._save_and_commit_sync(path, step, params,
+                                               opt_state, meta),
+        )
+        return path if ok else None
+
+    def close(self) -> None:
+        self.wait()
+        if self._ckptrs is not None:
+            for c in self._ckptrs:
+                c.close()
+            self._ckptrs = None
 
 
 def export_full_params(params: Any) -> dict[str, np.ndarray]:

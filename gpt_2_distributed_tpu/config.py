@@ -144,6 +144,45 @@ class GPT2Config:
         return n
 
 
+@dataclass(frozen=True)
+class CheckpointPolicy:
+    """Checkpoint-lifecycle policy (``checkpoint.CheckpointSaver``).
+
+    Separate from :class:`GPT2Config` because it describes the *run*, not the
+    model: two runs of the same architecture can save with different policies,
+    and the policy never participates in jit/compile caching.
+
+    * ``async_save`` — periodic saves snapshot device arrays (blocking
+      device->host copy only) and write/commit in the background, so the step
+      loop never stalls for the sharded OCDBT write (ROADMAP resilience
+      follow-up a). Emergency/final saves always finish synchronously.
+    * ``keep_last_n`` — retention GC: keep only the newest N *committed*
+      checkpoints (0 = keep everything). The newest committed checkpoint is
+      never deleted regardless of N; uncommitted/failed save dirs are always
+      pruned.
+    * ``save_retries`` / ``retry_backoff_s`` — transient save failures are
+      retried this many times with exponential backoff (delay doubles per
+      attempt). A save that exhausts its retries degrades to a warning +
+      ``save_failures`` metric instead of killing a multi-hour run — the next
+      periodic save is a fresh chance, and restore falls back past the gap.
+    """
+
+    async_save: bool = True
+    keep_last_n: int = 0
+    save_retries: int = 2
+    retry_backoff_s: float = 0.5
+
+    def __post_init__(self) -> None:
+        if self.keep_last_n < 0:
+            raise ValueError(f"keep_last_n={self.keep_last_n} must be >= 0")
+        if self.save_retries < 0:
+            raise ValueError(f"save_retries={self.save_retries} must be >= 0")
+        if self.retry_backoff_s < 0:
+            raise ValueError(
+                f"retry_backoff_s={self.retry_backoff_s} must be >= 0"
+            )
+
+
 # BASELINE.json configs 1-5 require these four sizes; the standard GPT-2 family.
 MODEL_PRESETS: dict[str, GPT2Config] = {
     "124M": GPT2Config(n_layer=12, n_embd=768, n_head=12),

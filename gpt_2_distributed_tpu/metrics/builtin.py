@@ -72,6 +72,23 @@ METRIC_REGISTRY.metric(
     "last_skip_reason", reduction=ReductionStrategy.CURRENT, cli_format=None,
 )(lambda v: float(int(v)))
 
+# Resilience (train.py --guard_max_grad_norm): cumulative count of steps whose
+# finite-but-huge gradient was per-layer-clipped and applied instead of
+# skipped. Like skipped_steps, pushed only once the first clip happens.
+METRIC_REGISTRY.metric(
+    "clipped_steps", reduction=ReductionStrategy.CURRENT,
+    cli_format="clipped: {value:.0f}",
+)(lambda v: float(int(v)))
+
+# Resilience (checkpoint.CheckpointSaver): cumulative count of checkpoint
+# saves that failed permanently (retries exhausted, or the async background
+# write died after the source buffers were donated away). Non-zero means the
+# run is progressing but its on-disk save cadence has gaps.
+METRIC_REGISTRY.metric(
+    "save_failures", reduction=ReductionStrategy.CURRENT,
+    cli_format="save_fail: {value:.0f}",
+)(lambda v: float(int(v)))
+
 # Periodic validation loss over the held-out shard (shard 0 is reserved as
 # "val" by the tokenizer pipeline, notebook cell 13 convention). The reference
 # reserves the split but never consumes it; the TPU build's --eval_every wires

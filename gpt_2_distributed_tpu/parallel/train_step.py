@@ -75,6 +75,8 @@ class GuardedStepMetrics(NamedTuple):
     grad_norm: jnp.ndarray
     skipped_steps: jnp.ndarray  # int32, cumulative updates skipped (post-step)
     skip_reason: jnp.ndarray    # int32 SKIP_* code for THIS step; 0 = applied
+    clipped_steps: jnp.ndarray  # int32, cumulative clipped-then-applied steps
+    clipped: jnp.ndarray        # int32, 1 iff THIS step was clip-applied
 
 
 def make_train_step(
@@ -85,6 +87,8 @@ def make_train_step(
     unroll_accum: bool = False,
     accum_dtype: jnp.dtype | None = None,
     guard: bool = False,
+    clip_threshold: float | None = None,
+    layer_clip_norm: float = 1.0,
 ) -> Callable:
     """Build the jitted train step.
 
@@ -136,6 +140,17 @@ def make_train_step(
     update), bumps ``skipped_steps`` and records the SKIP_* reason code —
     both also mirrored into :class:`GuardedStepMetrics` so the host can read
     them with the usual one-step lag without touching the donated state.
+
+    ``clip_threshold`` (guard mode only) adds the middle response between
+    "apply as-is" and "skip outright" (ROADMAP resilience item c): a step
+    whose gradient is *finite* but whose global norm exceeds the threshold
+    is not discarded — each gradient leaf ("layer") is clipped to L2 norm
+    ``layer_clip_norm`` and the update applies. Per-layer rather than global
+    rescale: a single exploding layer (the common case — one attention block
+    hitting a bad batch) is tamed without crushing every other layer's
+    signal by the shared global factor. Non-finite values still skip — no
+    amount of rescaling repairs a NaN. Clipped steps count in
+    ``clipped_steps`` (GuardState + metrics), not ``skipped_steps``.
     """
 
     def accumulate_grads(params, x, y, rng, step_idx, loss_scale=None):
@@ -237,10 +252,33 @@ def make_train_step(
             params, x, y, rng, step_idx, loss_scale
         )
         loss_ok = jnp.isfinite(loss)
-        ok = jnp.logical_and(loss_ok, jnp.isfinite(grad_norm))
+        finite = jnp.logical_and(loss_ok, jnp.isfinite(grad_norm))
+        if clip_threshold is not None:
+            huge = jnp.logical_and(finite, grad_norm > clip_threshold)
+        else:
+            huge = jnp.zeros((), bool)
+        ok = jnp.logical_and(finite, jnp.logical_not(huge))
 
         def apply_update(_):
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt_state
+
+        def clip_apply_update(_):
+            # Finite-but-huge gradient: clip each leaf to L2 norm
+            # `layer_clip_norm` and apply. eps in the denominator guards the
+            # all-zero leaf (norm 0 -> scale capped at 1 anyway, but 0/0
+            # would poison it with NaN).
+            def clip_leaf(g):
+                norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                scale = jnp.minimum(
+                    1.0, layer_clip_norm / jnp.maximum(norm, 1e-12)
+                )
+                return (g * scale.astype(g.dtype))
+
+            clipped = jax.tree_util.tree_map(clip_leaf, grads)
+            updates, new_opt_state = optimizer.update(
+                clipped, opt_state, params
+            )
             return optax.apply_updates(params, updates), new_opt_state
 
         def identity_update(_):
@@ -249,23 +287,29 @@ def make_train_step(
             # step is invisible to moment bias-correction and schedules.
             return params, opt_state
 
-        new_params, new_opt_state = jax.lax.cond(
-            ok, apply_update, identity_update, operand=None
+        # branch 0 = apply, 1 = clip+apply, 2 = skip. lax.switch (not nested
+        # cond) so only the selected update's HLO runs.
+        branch = jnp.where(ok, 0, jnp.where(huge, 1, 2)).astype(jnp.int32)
+        new_params, new_opt_state = jax.lax.switch(
+            branch,
+            [apply_update, clip_apply_update, identity_update],
+            None,
         )
+        skipped = (branch == 2).astype(jnp.int32)
+        clipped_now = (branch == 1).astype(jnp.int32)
         # A non-finite grad_norm under a finite loss (0*inf in the backward)
         # is distinguished from a non-finite loss itself.
         reason = jnp.where(
-            ok,
+            branch != 2,
             0,
             jnp.where(loss_ok, SKIP_NONFINITE_GRAD, SKIP_NONFINITE_LOSS),
         ).astype(jnp.int32)
         new_guard = GuardState(
-            skipped_steps=(
-                guard_state.skipped_steps + (1 - ok.astype(jnp.int32))
-            ),
+            skipped_steps=guard_state.skipped_steps + skipped,
             last_skip_reason=jnp.where(
-                ok, guard_state.last_skip_reason, reason
+                branch != 2, guard_state.last_skip_reason, reason
             ).astype(jnp.int32),
+            clipped_steps=guard_state.clipped_steps + clipped_now,
         )
         # Counters are duplicated into the metrics: guard_state is donated
         # into the NEXT step before the host reads metrics (one-step lag), so
@@ -275,6 +319,8 @@ def make_train_step(
             grad_norm=grad_norm,
             skipped_steps=new_guard.skipped_steps,
             skip_reason=reason,
+            clipped_steps=new_guard.clipped_steps,
+            clipped=clipped_now,
         )
         return new_params, new_opt_state, new_guard, metrics
 

@@ -48,6 +48,8 @@ import json
 import math
 import os
 import signal
+import threading
+import urllib.request
 from typing import NamedTuple
 
 # --- layer 1: guard state carried through the jitted train step -------------
@@ -68,6 +70,7 @@ class GuardState(NamedTuple):
 
     skipped_steps: object   # int32 scalar — total updates skipped this run
     last_skip_reason: object  # int32 scalar — SKIP_* code of the latest skip
+    clipped_steps: object   # int32 scalar — finite-but-huge grads clipped+applied
 
 
 def init_guard_state() -> GuardState:
@@ -76,6 +79,7 @@ def init_guard_state() -> GuardState:
     return GuardState(
         skipped_steps=jnp.zeros((), jnp.int32),
         last_skip_reason=jnp.zeros((), jnp.int32),
+        clipped_steps=jnp.zeros((), jnp.int32),
     )
 
 
@@ -217,13 +221,22 @@ def _file_crc32c(path: str) -> int:
 def build_manifest(path: str, step: int) -> dict:
     """Inventory every file under a checkpoint dir: relative path + size for
     all, CRC32C for files <= CRC_MAX_BYTES (always includes meta.json and the
-    orbax metadata/commit-marker files — they are tiny)."""
+    orbax metadata/commit-marker files — they are tiny).
+
+    The commit-protocol marker files (checkpoint.py: ``.INPROGRESS`` removed
+    and ``COMMITTED`` created at commit, AFTER the manifest is written) are
+    excluded — recording them would make the manifest stale the moment the
+    commit completes.
+    """
     entries = []
     for root, _dirs, files in os.walk(path):
         for name in sorted(files):
             fp = os.path.join(root, name)
             rel = os.path.relpath(fp, path)
-            if rel in (MANIFEST_NAME, MANIFEST_NAME + ".tmp"):
+            if rel in (
+                MANIFEST_NAME, MANIFEST_NAME + ".tmp",
+                ".INPROGRESS", "COMMITTED", "COMMITTED.tmp",
+            ):
                 continue
             size = os.path.getsize(fp)
             entry: dict = {"path": rel, "size": size}
@@ -320,13 +333,21 @@ class PreemptionHandler:
         self._prev: dict[int, object] = {}
 
     def _on_signal(self, signum, frame) -> None:  # noqa: ARG002 — signal API
+        self.trigger(f"received signal {signum}")
+
+    def trigger(self, reason: str) -> None:
+        """Raise the preemption flag from any source — the signal handler,
+        or :class:`PreemptionPoller` when the cloud metadata endpoint posts a
+        preemption notice. Safe from any thread (a bool store is atomic under
+        the GIL), idempotent."""
+        already = self._flag
         self._flag = True
-        print(
-            f"[preempt] received signal {signum}; will save an emergency "
-            f"checkpoint and exit {PREEMPTED_EXIT_CODE} at the next step "
-            "boundary",
-            flush=True,
-        )
+        if not already:
+            print(
+                f"[preempt] {reason}; will save an emergency checkpoint and "
+                f"exit {PREEMPTED_EXIT_CODE} at the next step boundary",
+                flush=True,
+            )
 
     def install(self) -> "PreemptionHandler":
         """Install handlers (main thread only — the signal-module contract);
@@ -341,6 +362,92 @@ class PreemptionHandler:
         for s, prev in self._prev.items():
             signal.signal(s, prev)
         self._prev.clear()
+
+    def preempted(self) -> bool:
+        return self._flag
+
+
+# GCE metadata server's preemption endpoint: returns "TRUE" once the VM has
+# been marked for preemption. Requires the Metadata-Flavor header; only
+# reachable from inside a GCE/TPU VM (tests inject a file:// URL instead).
+GCE_METADATA_PREEMPTED_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/preempted"
+)
+
+
+class PreemptionPoller:
+    """Poll a cloud preemption-notice endpoint; raise the same flag as
+    :class:`PreemptionHandler`.
+
+    SIGTERM (layer 4 above) is the *guaranteed* notice, but on GCE/TPU the
+    metadata server often flips ``instance/preempted`` to ``TRUE`` seconds
+    earlier than the signal lands — polling it buys extra grace time for the
+    emergency save. The poller runs on a daemon thread, checks every
+    ``interval_s``, and on a notice calls ``handler.trigger`` (when a handler
+    is attached) as well as setting its own flag, so the driver's existing
+    single ``preempted()`` check covers both sources.
+
+    Endpoint errors are counted (``poll_errors``) but never raise: off-cloud
+    the hostname simply doesn't resolve and the poller stays quiet. ``url``
+    accepts anything ``urllib`` can open — tests point it at a ``file://``
+    notice file and flip its contents to TRUE.
+    """
+
+    def __init__(
+        self,
+        url: str = GCE_METADATA_PREEMPTED_URL,
+        interval_s: float = 5.0,
+        handler: PreemptionHandler | None = None,
+    ) -> None:
+        self.url = url
+        self.interval_s = interval_s
+        self.handler = handler
+        self.poll_errors = 0
+        self._flag = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def poll_once(self) -> bool:
+        """One synchronous check; True iff the endpoint reports preemption."""
+        try:
+            req = urllib.request.Request(
+                self.url, headers={"Metadata-Flavor": "Google"}
+            )
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                body = resp.read(64).decode("utf-8", "replace").strip()
+            return body.upper().startswith("TRUE")
+        except Exception:
+            self.poll_errors += 1
+            return False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.poll_once():
+                self._flag = True
+                print(
+                    "[preempt] cloud preemption notice "
+                    f"({self.url})",
+                    flush=True,
+                )
+                if self.handler is not None:
+                    self.handler.trigger("cloud preemption notice")
+                return
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "PreemptionPoller":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="preempt-poller", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
     def preempted(self) -> bool:
         return self._flag

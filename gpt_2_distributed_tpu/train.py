@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import os
 import signal
+import time
 from typing import Any
 
 import numpy as np
@@ -120,6 +121,41 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--save_every", type=int, default=1000)
     p.add_argument("--save_dir", default=None)
+    p.add_argument(
+        "--async_save", default="on", choices=["on", "off"],
+        help="non-blocking periodic checkpoints (ROADMAP resilience item a): "
+        "the step loop pays only the device->host snapshot; the sharded "
+        "write, manifest+CRC verification, COMMITTED sentinel and retention "
+        "GC run on a background thread. 'off' restores fully synchronous "
+        "saves. Emergency/final saves are always synchronous and committed.",
+    )
+    p.add_argument(
+        "--keep_last_n", type=int, default=0,
+        help="retention GC: keep only the newest N committed checkpoints "
+        "(0 = keep all). The newest committed checkpoint is never deleted; "
+        "uncommitted/failed save dirs are always pruned.",
+    )
+    p.add_argument(
+        "--save_retries", type=int, default=2,
+        help="retry a transiently failing checkpoint save this many times "
+        "(exponential backoff); exhausted retries degrade to a warning + "
+        "the save_failures metric instead of killing the run",
+    )
+    p.add_argument(
+        "--save_retry_backoff", type=float, default=0.5,
+        help="initial save-retry backoff in seconds (doubles per attempt)",
+    )
+    p.add_argument(
+        "--preempt_poll_url", default=None,
+        help="poll this preemption-notice URL (e.g. the GCE metadata "
+        "endpoint, resilience.GCE_METADATA_PREEMPTED_URL) on a background "
+        "thread; a TRUE response triggers the same emergency-save + rc 143 "
+        "path as SIGTERM, usually with more grace time. Default: off.",
+    )
+    p.add_argument(
+        "--preempt_poll_interval", type=float, default=5.0,
+        help="seconds between preemption-notice polls",
+    )
     p.add_argument("--log_dir", default=None)
     p.add_argument("--workers", type=int, default=DEFAULT_NUM_WORKERS)
     p.add_argument("--prefetch_factor", type=int, default=DEFAULT_PREFETCH_FACTOR)
@@ -140,6 +176,19 @@ def build_parser() -> argparse.ArgumentParser:
         "step applies the identity update (params/opt-state unchanged) and "
         "is counted in the skipped_steps metric; 'off' restores the "
         "unguarded step exactly",
+    )
+    p.add_argument(
+        "--guard_max_grad_norm", type=float, default=0.0,
+        help="per-layer clip fallback (resilience, ROADMAP item c): when the "
+        "guard sees a FINITE gradient whose global norm exceeds this, clip "
+        "each layer to --guard_clip_norm and apply instead of skipping the "
+        "step; non-finite values still skip. Counted in the clipped_steps "
+        "metric. 0 = off; requires --step_guard on.",
+    )
+    p.add_argument(
+        "--guard_clip_norm", type=float, default=1.0,
+        help="per-layer L2 norm each gradient leaf is clipped to when the "
+        "--guard_max_grad_norm fallback engages",
     )
     p.add_argument(
         "--spike_sigma", type=float, default=6.0,
@@ -171,6 +220,27 @@ def build_parser() -> argparse.ArgumentParser:
         "completes (one-shot marker in --save_dir), exercising the "
         "preemption handler end-to-end: emergency save, exit rc 143, "
         "supervised resume. 0 = off; requires --save_dir.",
+    )
+    p.add_argument(
+        "--inject_save_fail_at", type=int, default=0,
+        help="fault injection: the first --inject_save_fail_count attempts "
+        "of the checkpoint save at step N raise, exercising the retry/"
+        "backoff path (and, when retries are exhausted, the degrade-to-"
+        "warning path) on CPU. One-shot marker in --save_dir. 0 = off; "
+        "requires --save_dir.",
+    )
+    p.add_argument(
+        "--inject_save_fail_count", type=int, default=1,
+        help="how many attempts of the injected save failure raise before "
+        "the save is allowed to succeed",
+    )
+    p.add_argument(
+        "--inject_preempt_notice_at", type=int, default=0,
+        help="fault injection: the preemption POLLER (not SIGTERM) sees a "
+        "cloud preemption notice once optimizer step N completes — a "
+        "file:// notice endpoint in --save_dir flips to TRUE, exercising "
+        "PreemptionPoller -> emergency save -> rc 143 end-to-end on CPU. "
+        "One-shot marker in --save_dir. 0 = off; requires --save_dir.",
     )
     p.add_argument(
         "--remat", nargs="?", const="block", default=False,
@@ -302,6 +372,12 @@ def main(argv: list[str] | None = None) -> None:
         build_parser().error("--inject_preempt_at needs --save_dir (one-shot marker + resume target)")
     if args.inject_nan_at and args.step_guard != "on":
         build_parser().error("--inject_nan_at requires --step_guard on (an unguarded NaN update poisons the params permanently)")
+    if args.inject_save_fail_at and not args.save_dir:
+        build_parser().error("--inject_save_fail_at needs --save_dir (one-shot marker + save target)")
+    if args.inject_preempt_notice_at and not args.save_dir:
+        build_parser().error("--inject_preempt_notice_at needs --save_dir (notice file + one-shot marker)")
+    if args.guard_max_grad_norm and args.step_guard != "on":
+        build_parser().error("--guard_max_grad_norm requires --step_guard on (the clip fallback lives inside the guarded step)")
 
     # Honor --device (highest priority) then JAX_PLATFORMS, even when a site
     # boot hook force-registered a different backend before us (observed: an
@@ -327,10 +403,12 @@ def main(argv: list[str] | None = None) -> None:
     import jax
 
     from gpt_2_distributed_tpu import checkpoint as ckpt
+    from gpt_2_distributed_tpu.config import CheckpointPolicy
     from gpt_2_distributed_tpu.resilience import (
         PREEMPTED_EXIT_CODE,
         SKIP_REASON_NAMES,
         PreemptionHandler,
+        PreemptionPoller,
         SpikeMonitor,
         init_guard_state,
     )
@@ -434,6 +512,8 @@ def main(argv: list[str] | None = None) -> None:
             config, optimizer,
             accum_dtype=jnp.bfloat16 if args.accum_dtype == "bf16" else None,
             guard=use_guard,
+            clip_threshold=args.guard_max_grad_norm or None,
+            layer_clip_norm=args.guard_clip_norm,
         )
         guard_state = init_guard_state() if use_guard else None
         monitor = (
@@ -450,9 +530,40 @@ def main(argv: list[str] | None = None) -> None:
         )
         nan_scale = ones_scale.at[0].set(jnp.nan) if use_guard else None
 
+        # --- checkpoint lifecycle -------------------------------------------
+        # One saver per run: async writes + commit protocol + retries + GC
+        # (checkpoint.CheckpointSaver). Fault injection for the retry path is
+        # one-shot across supervised relaunches, like --inject_fail_at.
+        saver = None
+        if args.save_dir:
+            saver = ckpt.CheckpointSaver(
+                args.save_dir,
+                CheckpointPolicy(
+                    async_save=args.async_save == "on",
+                    keep_last_n=args.keep_last_n,
+                    save_retries=args.save_retries,
+                    retry_backoff_s=args.save_retry_backoff,
+                ),
+            )
+            if args.inject_save_fail_at and _claim_one_shot(
+                args.save_dir,
+                f"save_fail_injected_{args.inject_save_fail_at}",
+                set(),
+            ):
+                saver.inject_fail_at = args.inject_save_fail_at
+                saver.inject_fail_count = args.inject_save_fail_count
+
         # --- resume ---------------------------------------------------------
         start_epoch, skip_steps, global_step, total_tokens = 0, 0, 0, 0
         if args.resume and args.save_dir:
+            # Prune stale uncommitted dirs (a crash mid-async-save leaves one)
+            # and apply retention before picking a restore candidate.
+            removed = ckpt.gc_checkpoints(args.save_dir, args.keep_last_n)
+            if removed and is_primary():
+                print(
+                    "[ckpt] pruned on resume: "
+                    + ", ".join(os.path.basename(p) for p in removed)
+                )
             restored = ckpt.restore_latest_verified(
                 args.save_dir, params, opt_state, param_shardings, opt_shardings
             )
@@ -494,6 +605,14 @@ def main(argv: list[str] | None = None) -> None:
             peak_flops_per_chip=device_peak_flops(),
         )
         tracker.total_tokens = total_tokens
+
+        def make_meta(step: int, ep: int, batches: int) -> "ckpt.CheckpointMeta":
+            return ckpt.CheckpointMeta(
+                step=step, epoch=ep, batches_in_epoch=batches,
+                rng_seed=args.seed,
+                total_tokens=tracker.total_tokens,
+                spike_monitor=monitor.state_dict() if monitor else None,
+            )
 
         # --- evaluation -------------------------------------------------------
         # Consumes the val split (shard 0 by the tokenizer's convention) the
@@ -568,6 +687,38 @@ def main(argv: list[str] | None = None) -> None:
         # emergency checkpoint, and exits rc 143 for a supervised --resume.
         preempt = PreemptionHandler().install()
 
+        # Cloud-notice poller (ROADMAP item d): same flag, second source.
+        # --inject_preempt_notice_at points it at a file:// endpoint in
+        # --save_dir that the step loop flips to TRUE — the whole poller ->
+        # emergency-save -> rc 143 path runs on CPU with no cloud in sight.
+        poller = None
+        notice_path = None
+        if args.inject_preempt_notice_at:
+            notice_path = os.path.join(
+                os.path.abspath(args.save_dir), "preempt_notice.txt"
+            )
+            # Reset to FALSE on every launch: a relaunch after the injected
+            # preemption must not re-read last run's TRUE and exit again.
+            os.makedirs(os.path.dirname(notice_path), exist_ok=True)
+            with open(notice_path, "w") as f:
+                f.write("FALSE")
+        if args.preempt_poll_url or notice_path:
+            poller = PreemptionPoller(
+                url=args.preempt_poll_url or f"file://{notice_path}",
+                interval_s=(
+                    min(args.preempt_poll_interval, 0.05)
+                    if notice_path else args.preempt_poll_interval
+                ),
+                handler=preempt,
+            ).start()
+
+        def stop_aux() -> None:
+            """Quiesce the background machinery at every exit path."""
+            if poller is not None:
+                poller.stop()
+            if saver is not None:
+                saver.close()
+
         # --- epoch/step loop --------------------------------------------------
         # Metrics are consumed with a one-step lag: step N+1 is dispatched
         # (async) before step N's loss is read back, so the host->device
@@ -607,6 +758,20 @@ def main(argv: list[str] | None = None) -> None:
                         "skipped_steps": int(p_m.skipped_steps),
                         "last_skip_reason": last_skip_reason_host,
                     }
+                if int(p_m.clipped):
+                    if is_primary():
+                        print(
+                            f"[guard] step {p_step} grad norm "
+                            f"{float(p_m.grad_norm):.2f} exceeded "
+                            f"--guard_max_grad_norm "
+                            f"{args.guard_max_grad_norm:g}; clipped "
+                            f"per-layer to {args.guard_clip_norm:g} and "
+                            f"applied (total clipped: "
+                            f"{int(p_m.clipped_steps)})",
+                            flush=True,
+                        )
+                if int(p_m.clipped_steps):
+                    extra["clipped_steps"] = int(p_m.clipped_steps)
                 verdict = monitor.observe(float(p_m.loss), skipped=bool(reason))
                 if verdict == "rollback":
                     rollback_requested = True
@@ -617,6 +782,8 @@ def main(argv: list[str] | None = None) -> None:
                         f"{monitor.consecutive} consecutive anomalies)",
                         flush=True,
                     )
+            if saver is not None and saver.failed_saves:
+                extra["save_failures"] = saver.failed_saves
             # p_step is the post-increment global step; optax evaluated the
             # schedule at count p_step - 1 for that update, so log that one.
             # A skipped step's loss/grad_norm are the REJECTED values (the
@@ -632,7 +799,6 @@ def main(argv: list[str] | None = None) -> None:
             tracker.update(p_step, **values, **extra)
 
         done = False
-        last_saved_step = -1
         rollbacks_done = 0
         fired: set = set()  # in-process one-shot injections (no --save_dir)
         epoch, step_in_epoch = start_epoch, skip_steps
@@ -725,18 +891,9 @@ def main(argv: list[str] | None = None) -> None:
                         # rollback would restore this very checkpoint.
                         and not rollback_requested
                     ):
-                        last_saved_step = global_step
-                        ckpt.save_checkpoint(
-                            args.save_dir, global_step, params, opt_state,
-                            ckpt.CheckpointMeta(
-                                step=global_step, epoch=epoch,
-                                batches_in_epoch=step_in_epoch,
-                                rng_seed=args.seed,
-                                total_tokens=tracker.total_tokens,
-                                spike_monitor=(
-                                    monitor.state_dict() if monitor else None
-                                ),
-                            ),
+                        saver.save(
+                            global_step, params, opt_state,
+                            make_meta(global_step, epoch, step_in_epoch),
                         )
                     if rollback_requested:
                         break
@@ -747,6 +904,14 @@ def main(argv: list[str] | None = None) -> None:
                         if not os.path.exists(marker):
                             flush_pending()
                             tracker.close()
+                            if saver is not None:
+                                # Quiesce in-flight async commits first: the
+                                # injected crash models "process dies between
+                                # steps", and the resume-from-cursor contract
+                                # it tests predates async saves. The commit
+                                # race itself (crash between write and commit)
+                                # is covered by its own checkpoint tests.
+                                saver.wait()
                             os.makedirs(args.save_dir, exist_ok=True)
                             with open(marker, "w") as f:
                                 f.write(str(global_step))
@@ -771,24 +936,44 @@ def main(argv: list[str] | None = None) -> None:
                             flush=True,
                         )
                         os.kill(os.getpid(), signal.SIGTERM)
+                    if (
+                        args.inject_preempt_notice_at
+                        and global_step >= args.inject_preempt_notice_at
+                        and _claim_one_shot(
+                            args.save_dir,
+                            f"preempt_notice_injected_{args.inject_preempt_notice_at}",
+                            fired,
+                        )
+                    ):
+                        print(
+                            f"[inject] cloud preemption notice after step "
+                            f"{global_step}",
+                            flush=True,
+                        )
+                        with open(notice_path, "w") as f:
+                            f.write("TRUE")
+                        # Wait for the poller (interval <= 50ms here) to see
+                        # it, so the emergency save lands deterministically at
+                        # THIS step boundary rather than a test-flaky later one.
+                        deadline = time.monotonic() + 2.0
+                        while (
+                            not preempt.preempted()
+                            and time.monotonic() < deadline
+                        ):
+                            time.sleep(0.01)
                     if preempt.preempted():
                         flush_pending()
                         if args.profile and args.log_dir:
                             jax.profiler.stop_trace()
-                        if args.save_dir and global_step != last_saved_step:
-                            ckpt.save_checkpoint(
-                                args.save_dir, global_step, params, opt_state,
-                                ckpt.CheckpointMeta(
-                                    step=global_step, epoch=epoch,
-                                    batches_in_epoch=step_in_epoch,
-                                    rng_seed=args.seed,
-                                    total_tokens=tracker.total_tokens,
-                                    spike_monitor=(
-                                        monitor.state_dict() if monitor else None
-                                    ),
-                                ),
+                        if saver is not None:
+                            # wait-or-supersede: drains any in-flight async
+                            # save first; never two writers in one step dir.
+                            saver.ensure_committed_sync(
+                                global_step, params, opt_state,
+                                make_meta(global_step, epoch, step_in_epoch),
                             )
                         tracker.close()
+                        stop_aux()
                         preempt.uninstall()
                         if is_primary():
                             print(
@@ -816,12 +1001,18 @@ def main(argv: list[str] | None = None) -> None:
                 rollbacks_done += 1
                 if rollbacks_done > args.max_rollbacks:
                     tracker.close()
+                    stop_aux()
                     preempt.uninstall()
                     raise SystemExit(
                         f"error: loss diverged through {rollbacks_done} "
                         f"rollbacks (--max_rollbacks {args.max_rollbacks}); "
                         f"stopping"
                     )
+                if saver is not None:
+                    # An in-flight async save may be about to commit the very
+                    # checkpoint we want to restore — drain it first (also
+                    # keeps its GC from racing the restore's directory scan).
+                    saver.wait()
                 restored = (
                     ckpt.restore_latest_verified(
                         args.save_dir, params, opt_state,
@@ -859,19 +1050,21 @@ def main(argv: list[str] | None = None) -> None:
         preempt.uninstall()
         if args.profile and args.log_dir:
             jax.profiler.stop_trace()
-        if args.save_dir and global_step != last_saved_step:
-            ckpt.save_checkpoint(
-                args.save_dir, global_step, params, opt_state,
-                ckpt.CheckpointMeta(
-                    step=global_step,
-                    epoch=min(epoch, args.epochs - 1) if args.epochs else 0,
-                    batches_in_epoch=step_in_epoch,
-                    rng_seed=args.seed,
-                    total_tokens=tracker.total_tokens,
-                    spike_monitor=monitor.state_dict() if monitor else None,
+        if saver is not None:
+            # ensure_committed_sync covers every ending: nothing saved this
+            # step -> sync save now; async save of this step still in flight
+            # -> drain it; already committed -> no-op. Either way the run
+            # ends with a committed checkpoint at the final step.
+            saver.ensure_committed_sync(
+                global_step, params, opt_state,
+                make_meta(
+                    global_step,
+                    min(epoch, args.epochs - 1) if args.epochs else 0,
+                    step_in_epoch,
                 ),
             )
         tracker.close()
+        stop_aux()
         if is_primary():
             print(f"training done: {global_step} optimizer steps")
 

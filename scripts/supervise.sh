@@ -21,8 +21,40 @@ set -uo pipefail  # no -e: the exit code is inspected, not fatal
 MAX_RESTARTS="${MAX_RESTARTS:-3}"
 RESTART_DELAY="${RESTART_DELAY:-2}"
 
+# Extract --save_dir from the wrapped command line so the wrapper can clean
+# stale checkpoint dirs between attempts (both "--save_dir DIR" and
+# "--save_dir=DIR" spellings).
+SAVE_DIR=""
+prev=""
+for arg in "$@"; do
+    case "$arg" in
+        --save_dir=*) SAVE_DIR="${arg#--save_dir=}" ;;
+    esac
+    if [ "$prev" = "--save_dir" ]; then
+        SAVE_DIR="$arg"
+    fi
+    prev="$arg"
+done
+
+cleanup_stale() {
+    # A crash mid-async-save leaves a step_* dir with the .INPROGRESS marker
+    # but no COMMITTED sentinel (checkpoint.py commit protocol). restore
+    # skips such dirs anyway; removing them here keeps the save_dir from
+    # accumulating junk across restarts. Dirs with NEITHER marker are legacy
+    # checkpoints and are left alone.
+    [ -n "$SAVE_DIR" ] && [ -d "$SAVE_DIR" ] || return 0
+    for d in "$SAVE_DIR"/step_*; do
+        [ -d "$d" ] || continue
+        if [ -e "$d/.INPROGRESS" ] && [ ! -e "$d/COMMITTED" ]; then
+            echo "[supervise] removing stale uncommitted checkpoint $d" >&2
+            rm -rf "$d"
+        fi
+    done
+}
+
 attempt=0
 while :; do
+    cleanup_stale
     "$@" --resume
     rc=$?
     if [ "$rc" -eq 0 ]; then
@@ -34,10 +66,12 @@ while :; do
         exit "$rc"
     fi
     if [ "$rc" -eq 143 ]; then
-        # 128+SIGTERM: the preemption contract (train.py PreemptionHandler).
-        # The run saved an emergency checkpoint and asked to be resumed —
-        # that's cooperative rescheduling, not a failure, so it never burns
-        # one of the MAX_RESTARTS crash attempts.
+        # 128+SIGTERM: the preemption contract — raised by the SIGTERM
+        # handler (train.py PreemptionHandler) OR by the cloud-notice poller
+        # (resilience.PreemptionPoller), same rc either way. The run saved a
+        # committed emergency checkpoint and asked to be resumed — that's
+        # cooperative rescheduling, not a failure, so it never burns one of
+        # the MAX_RESTARTS crash attempts.
         echo "[supervise] preempted (rc=143); resuming from the emergency" \
              "checkpoint (does not count against MAX_RESTARTS)" >&2
         sleep "$RESTART_DELAY"

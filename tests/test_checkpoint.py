@@ -1,15 +1,19 @@
 """Checkpoint save/restore tests — the capability the reference stubs out
 (``/root/reference/train_gpt2_distributed.py:104-111``): round-trip fidelity,
-sharded restore onto a mesh, resume-exactness of the train step.
+sharded restore onto a mesh, resume-exactness of the train step, and the
+async-save commit protocol (CheckpointSaver).
 """
 
 import os
+import threading
+import time
 
 import jax
 import numpy as np
 import pytest
 
 from gpt_2_distributed_tpu import checkpoint as ckpt
+from gpt_2_distributed_tpu.config import CheckpointPolicy
 from gpt_2_distributed_tpu.models import gpt2
 from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, activate_mesh, create_mesh
 from gpt_2_distributed_tpu.parallel.sharding import (
@@ -215,3 +219,246 @@ def test_restore_rejects_same_rank_reshape(tmp_path, tiny_config):
     )
     with pytest.raises(ValueError, match="incompatible"):
         ckpt.restore_checkpoint(path, params, opt_state)
+
+
+# --- commit protocol + CheckpointSaver ---------------------------------------
+
+
+def _meta(step):
+    return ckpt.CheckpointMeta(
+        step=step, epoch=0, batches_in_epoch=step, rng_seed=0
+    )
+
+
+def test_sync_save_writes_commit_markers(tmp_path, trained_state):
+    params, opt_state, _ = trained_state
+    path = ckpt.save_checkpoint(str(tmp_path), 2, params, opt_state, _meta(2))
+    assert os.path.exists(os.path.join(path, ckpt.COMMITTED_NAME))
+    assert not os.path.exists(os.path.join(path, ckpt.INPROGRESS_NAME))
+    assert ckpt.is_committed_checkpoint(path)
+    # The markers are commit metadata, not payload: the manifest must not
+    # inventory them (COMMITTED lands after the manifest is written).
+    import json
+
+    with open(os.path.join(path, "manifest.json")) as f:
+        names = {e["path"] for e in json.load(f)["entries"]}
+    assert ckpt.COMMITTED_NAME not in names
+    assert ckpt.INPROGRESS_NAME not in names
+
+
+def test_uncommitted_dir_hidden_from_listing_and_pruned(
+    tmp_path, trained_state
+):
+    params, opt_state, _ = trained_state
+    good = ckpt.save_checkpoint(str(tmp_path), 1, params, opt_state, _meta(1))
+    # Fabricate a save that died mid-write: .INPROGRESS, no COMMITTED.
+    bad = str(tmp_path / "step_0000002")
+    os.makedirs(bad)
+    with open(os.path.join(bad, ckpt.INPROGRESS_NAME), "w") as f:
+        f.write("1\n")
+    with open(os.path.join(bad, "meta.json"), "w") as f:
+        f.write(_meta(2).to_json())
+
+    assert [s for s, _ in ckpt.list_checkpoints(str(tmp_path))] == [1]
+    assert ckpt.latest_checkpoint(str(tmp_path)) == good
+    assert [s for s, _ in ckpt.list_checkpoints(
+        str(tmp_path), committed_only=False)] == [1, 2]
+    assert ckpt.list_uncommitted(str(tmp_path)) == [bad]
+
+    removed = ckpt.gc_checkpoints(str(tmp_path))  # keep_last_n=0: only junk
+    assert removed == [bad]
+    assert not os.path.exists(bad) and os.path.exists(good)
+
+
+def test_crash_between_write_and_commit_skipped_then_gcd(
+    tmp_path, trained_state, capsys
+):
+    """Acceptance path: arrays + manifest fully on disk but the process died
+    before COMMITTED landed — restore must skip it on the commit protocol
+    alone (the content would pass verification!) and GC must prune it."""
+    params, opt_state, _ = trained_state
+    good = ckpt.save_checkpoint(str(tmp_path), 1, params, opt_state, _meta(1))
+    bad = ckpt.save_checkpoint(str(tmp_path), 2, params, opt_state, _meta(2))
+    os.remove(os.path.join(bad, ckpt.COMMITTED_NAME))
+    with open(os.path.join(bad, ckpt.INPROGRESS_NAME), "w") as f:
+        f.write("1\n")
+
+    restored = ckpt.restore_latest_verified(str(tmp_path), params, opt_state)
+    assert restored is not None
+    assert restored[3] == good and restored[2].step == 1
+    out = capsys.readouterr().out
+    assert "skipping uncommitted checkpoint" in out
+    assert "step_0000002" in out
+
+    assert ckpt.gc_checkpoints(str(tmp_path)) == [bad]
+    assert not os.path.exists(bad)
+
+
+def test_legacy_dir_without_markers_stays_trusted(tmp_path, trained_state):
+    """Checkpoints written before the commit protocol (no marker at all) keep
+    working: listed, restorable, never GC'd as junk."""
+    params, opt_state, _ = trained_state
+    path = ckpt.save_checkpoint(str(tmp_path), 3, params, opt_state, _meta(3))
+    os.remove(os.path.join(path, ckpt.COMMITTED_NAME))  # -> legacy state
+    assert ckpt.is_committed_checkpoint(path)
+    assert ckpt.latest_checkpoint(str(tmp_path)) == path
+    assert ckpt.gc_checkpoints(str(tmp_path)) == []
+    r_params, _r_opt, r_meta = ckpt.restore_checkpoint(path, params, opt_state)
+    assert r_meta.step == 3 and tree_equal(params, r_params)
+
+
+def test_gc_keep_last_n_never_removes_newest_committed(
+    tmp_path, trained_state
+):
+    params, opt_state, _ = trained_state
+    for s in (1, 2, 3, 4):
+        ckpt.save_checkpoint(str(tmp_path), s, params, opt_state, _meta(s))
+
+    removed = ckpt.gc_checkpoints(str(tmp_path), keep_last_n=2)
+    assert sorted(os.path.basename(p) for p in removed) == [
+        "step_0000001", "step_0000002"
+    ]
+    assert [s for s, _ in ckpt.list_checkpoints(str(tmp_path))] == [3, 4]
+    removed = ckpt.gc_checkpoints(str(tmp_path), keep_last_n=1)
+    assert [os.path.basename(p) for p in removed] == ["step_0000003"]
+    # The newest committed checkpoint is structurally unremovable.
+    assert ckpt.gc_checkpoints(str(tmp_path), keep_last_n=1) == []
+    assert ckpt.latest_checkpoint(str(tmp_path)).endswith("step_0000004")
+
+
+def test_async_save_invisible_until_committed(tmp_path, trained_state):
+    """The tentpole contract end-to-end: save() returns while the checkpoint
+    is still uncommitted (held open via the pre-commit test seam), nothing
+    surfaces it meanwhile, and after the gate opens it commits, verifies and
+    round-trips."""
+    params, opt_state, _ = trained_state
+    saver = ckpt.CheckpointSaver(
+        str(tmp_path), CheckpointPolicy(async_save=True)
+    )
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def hold(_path):
+        entered.set()
+        gate.wait(timeout=30)
+
+    saver.pre_commit_hook = hold
+    try:
+        path = saver.save(1, params, opt_state, _meta(1))
+        assert path is not None
+        assert entered.wait(timeout=30), "background write never finished"
+        # In-flight: marked, hidden from every discovery surface.
+        assert os.path.exists(os.path.join(path, ckpt.INPROGRESS_NAME))
+        assert not os.path.exists(os.path.join(path, ckpt.COMMITTED_NAME))
+        assert ckpt.latest_checkpoint(str(tmp_path)) is None
+        assert ckpt.list_uncommitted(str(tmp_path)) == [path]
+
+        gate.set()
+        saver.wait(timeout=60)
+        assert saver.committed_steps == [1] and saver.failed_saves == 0
+        assert ckpt.is_committed_checkpoint(path)
+        assert ckpt.latest_checkpoint(str(tmp_path)) == path
+        r_params, r_opt, r_meta = ckpt.restore_checkpoint(
+            path, params, opt_state
+        )
+        assert r_meta.step == 1
+        assert tree_equal(params, r_params) and tree_equal(opt_state, r_opt)
+    finally:
+        gate.set()
+        saver.close()
+
+
+def test_saver_retries_transient_failure_then_succeeds(
+    tmp_path, trained_state, capsys
+):
+    params, opt_state, _ = trained_state
+    saver = ckpt.CheckpointSaver(
+        str(tmp_path),
+        CheckpointPolicy(async_save=True, save_retries=2,
+                         retry_backoff_s=0.01),
+    )
+    saver.inject_fail_at = 5
+    saver.inject_fail_count = 1  # first attempt fails, retry lands
+    try:
+        path = saver.save(5, params, opt_state, _meta(5))
+        saver.wait(timeout=60)
+        assert path is not None and saver.failed_saves == 0
+        assert saver.committed_steps == [5]
+        assert ckpt.is_committed_checkpoint(path)
+    finally:
+        saver.close()
+    out = capsys.readouterr().out
+    assert "failed (attempt 1/3)" in out and "retrying" in out
+    assert "WARNING" not in out
+
+
+def test_saver_exhausted_retries_degrade_without_raising(
+    tmp_path, trained_state, capsys
+):
+    params, opt_state, _ = trained_state
+    saver = ckpt.CheckpointSaver(
+        str(tmp_path),
+        CheckpointPolicy(async_save=True, save_retries=1,
+                         retry_backoff_s=0.01),
+    )
+    saver.inject_fail_at = 7
+    saver.inject_fail_count = 10  # more failures than attempts
+    try:
+        ret = saver.save(7, params, opt_state, _meta(7))
+        assert ret is None
+        assert saver.failed_saves == 1 and saver.committed_steps == []
+        assert "injected save failure" in saver.last_error
+        assert ckpt.latest_checkpoint(str(tmp_path)) is None
+    finally:
+        saver.close()
+    out = capsys.readouterr().out
+    assert "failed permanently after 2 attempts" in out
+    assert "training continues without this checkpoint" in out
+
+
+def test_emergency_save_waits_out_in_flight_async_save(
+    tmp_path, trained_state
+):
+    """wait-or-supersede, wait arm: ensure_committed_sync called while the
+    same step's async save is mid-commit must drain it and NOT double-write
+    (exactly one commit of the dir)."""
+    params, opt_state, _ = trained_state
+    saver = ckpt.CheckpointSaver(
+        str(tmp_path), CheckpointPolicy(async_save=True)
+    )
+    saver.pre_commit_hook = lambda _path: time.sleep(0.3)
+    try:
+        saver.save(2, params, opt_state, _meta(2))
+        path = saver.ensure_committed_sync(2, params, opt_state, _meta(2))
+        assert path is not None and ckpt.is_committed_checkpoint(path)
+        # One commit, not two: the emergency path recognized the drained
+        # async save already covered this step.
+        assert saver.committed_steps == [2]
+    finally:
+        saver.close()
+
+
+def test_emergency_save_supersedes_failed_async_save(
+    tmp_path, trained_state
+):
+    """wait-or-supersede, supersede arm: the async save failed permanently,
+    so the emergency path must produce a committed checkpoint itself."""
+    params, opt_state, _ = trained_state
+    saver = ckpt.CheckpointSaver(
+        str(tmp_path),
+        CheckpointPolicy(async_save=True, save_retries=0,
+                         retry_backoff_s=0.01),
+    )
+    saver.inject_fail_at = 3
+    saver.inject_fail_count = 1
+    try:
+        assert saver.save(3, params, opt_state, _meta(3)) is None
+        assert saver.failed_saves == 1
+        path = saver.ensure_committed_sync(3, params, opt_state, _meta(3))
+        assert path is not None and ckpt.is_committed_checkpoint(path)
+        assert saver.committed_steps == [3]
+        from gpt_2_distributed_tpu.resilience import verify_checkpoint
+
+        assert verify_checkpoint(path) == []
+    finally:
+        saver.close()

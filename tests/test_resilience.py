@@ -17,10 +17,13 @@ import pytest
 
 from gpt_2_distributed_tpu import resilience
 from gpt_2_distributed_tpu import train as train_mod
+from gpt_2_distributed_tpu import checkpoint as ckpt_mod
 from gpt_2_distributed_tpu.resilience import (
     PREEMPTED_EXIT_CODE,
     SKIP_NONFINITE_GRAD,
     SKIP_NONFINITE_LOSS,
+    PreemptionHandler,
+    PreemptionPoller,
     SpikeMonitor,
     crc32c,
     init_guard_state,
@@ -101,6 +104,88 @@ def test_guard_reason_codes_distinct():
     assert SKIP_NONFINITE_LOSS != SKIP_NONFINITE_GRAD
     assert resilience.SKIP_REASON_NAMES[SKIP_NONFINITE_LOSS] == "nonfinite_loss"
     assert resilience.SKIP_REASON_NAMES[SKIP_NONFINITE_GRAD] == "nonfinite_grad"
+
+
+def _tiny_setup_clip(clip_threshold, layer_clip_norm=0.5):
+    """_tiny_setup with the per-layer clip fallback armed."""
+    import jax
+    import jax.numpy as jnp
+
+    from gpt_2_distributed_tpu.config import GPT2Config
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.parallel.train_step import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    cfg = GPT2Config(
+        vocab_size=257, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+        embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
+    )
+    params = gpt2.init_params(cfg)
+    opt = make_optimizer(1e-3)
+    opt_state = opt.init(params)
+    step = make_train_step(
+        cfg, opt, compute_dtype=jnp.float32, donate=False, guard=True,
+        clip_threshold=clip_threshold, layer_clip_norm=layer_clip_norm,
+    )
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 257, (2, 4, 16)).astype(np.int32)
+    y = rng.integers(0, 257, (2, 4, 16)).astype(np.int32)
+    return jax, jnp, step, params, opt_state, x, y
+
+
+def test_guard_clips_huge_finite_grad_and_applies():
+    """ROADMAP item (c): a finite gradient above --guard_max_grad_norm is no
+    longer discarded — each leaf is clipped to the per-layer norm and the
+    update applies. clipped_steps counts it; skipped_steps does not."""
+    jax, jnp, step, params, opt_state, x, y = _tiny_setup_clip(
+        clip_threshold=1e-4  # any real gradient trips it
+    )
+    key = jax.random.PRNGKey(0)
+    gs = init_guard_state()
+    ones = jnp.ones((2,), jnp.float32)
+
+    p1, o1, gs1, m1 = step(params, opt_state, gs, x, y, key, 0, ones)
+    assert int(m1.clipped) == 1 and int(m1.clipped_steps) == 1
+    assert int(m1.skipped_steps) == 0 and int(m1.skip_reason) == 0
+    assert int(gs1.clipped_steps) == 1
+    assert not _trees_equal(params, p1), "clipped step must still update"
+    assert not _trees_equal(opt_state, o1)
+
+    p2, _o2, gs2, m2 = step(p1, o1, gs1, x, y, key, 1, ones)
+    assert int(m2.clipped_steps) == 2 and int(gs2.clipped_steps) == 2
+    assert not _trees_equal(p1, p2)
+
+
+def test_guard_clip_fallback_nonfinite_still_skips():
+    """The clip fallback rescues only FINITE outliers: non-finite values keep
+    taking the skip path (clipping a NaN just applies NaN)."""
+    jax, jnp, step, params, opt_state, x, y = _tiny_setup_clip(
+        clip_threshold=1e-4
+    )
+    key = jax.random.PRNGKey(0)
+    gs = init_guard_state()
+    bad = jnp.ones((2,), jnp.float32).at[0].set(float("nan"))
+
+    p1, o1, gs1, m1 = step(params, opt_state, gs, x, y, key, 0, bad)
+    assert int(m1.skipped_steps) == 1
+    assert int(m1.skip_reason) == SKIP_NONFINITE_LOSS
+    assert int(m1.clipped) == 0 and int(m1.clipped_steps) == 0
+    assert _trees_equal(params, p1) and _trees_equal(opt_state, o1)
+
+
+def test_guard_clip_threshold_not_tripped_applies_normally():
+    jax, jnp, step, params, opt_state, x, y = _tiny_setup_clip(
+        clip_threshold=1e9  # never tripped
+    )
+    key = jax.random.PRNGKey(0)
+    gs = init_guard_state()
+    ones = jnp.ones((2,), jnp.float32)
+    p1, _o1, gs1, m1 = step(params, opt_state, gs, x, y, key, 0, ones)
+    assert int(m1.clipped) == 0 and int(gs1.clipped_steps) == 0
+    assert int(m1.skipped_steps) == 0
+    assert not _trees_equal(params, p1)
 
 
 # --- layer 2: SpikeMonitor ---------------------------------------------------
@@ -254,6 +339,67 @@ def test_verify_legacy_checkpoint_without_manifest(tmp_path):
     assert any("meta.json" in p for p in verify_checkpoint(path))
 
 
+# --- layer 4b: cloud preemption-notice poller --------------------------------
+
+
+def _wait_until(predicate, timeout=5.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_preemption_poller_file_notice_flips_flag(tmp_path):
+    notice = tmp_path / "preempted"
+    notice.write_text("FALSE")
+    poller = PreemptionPoller(url=f"file://{notice}", interval_s=0.02)
+    try:
+        assert poller.poll_once() is False
+        poller.start()
+        import time
+
+        time.sleep(0.1)
+        assert not poller.preempted()
+        notice.write_text("TRUE")
+        assert _wait_until(poller.preempted), "poller never saw the notice"
+    finally:
+        poller.stop()
+
+
+def test_preemption_poller_unreachable_endpoint_stays_quiet(tmp_path):
+    # Off-cloud the metadata hostname doesn't resolve: errors are counted,
+    # the flag never raises, nothing is thrown.
+    poller = PreemptionPoller(
+        url=f"file://{tmp_path}/does_not_exist", interval_s=0.01
+    )
+    assert poller.poll_once() is False
+    assert poller.poll_errors == 1
+    assert not poller.preempted()
+
+
+def test_preemption_poller_triggers_shared_handler(tmp_path, capsys):
+    # The poller and SIGTERM share one flag: the driver's single preempted()
+    # check covers both notice sources.
+    notice = tmp_path / "preempted"
+    notice.write_text("TRUE")
+    handler = PreemptionHandler()  # not installed: no signal plumbing needed
+    poller = PreemptionPoller(
+        url=f"file://{notice}", interval_s=0.01, handler=handler
+    )
+    try:
+        poller.start()
+        assert _wait_until(handler.preempted)
+    finally:
+        poller.stop()
+    out = capsys.readouterr().out
+    assert "[preempt] cloud preemption notice" in out
+    assert "exit 143" in out  # handler.trigger announced the contract
+
+
 # --- CLI integration ---------------------------------------------------------
 
 
@@ -388,3 +534,107 @@ def test_cli_preempt_emergency_save_and_bit_exact_resume(
     assert _trees_equal(ref, resumed), (
         "preempt + resume must land on the uninterrupted run's trajectory"
     )
+
+
+def test_cli_async_save_overlaps_training(
+    capsys, shard_dir, tmp_path, monkeypatch
+):
+    """The async pipeline's acceptance proof: with the commit stage delayed
+    (test seam), later optimizer steps log BEFORE the step-2 checkpoint
+    commits — training never waited on the write — and every periodic
+    checkpoint still ends the run committed."""
+    monkeypatch.setenv(ckpt_mod.COMMIT_DELAY_ENV, "1.0")
+    out = run_cli(
+        capsys, *_common(shard_dir, tmp_path),
+        "--save_every", "2", "--max_steps", "4",
+    )
+    initiated = out.index("[ckpt] async save initiated (step_0000002)")
+    committed = out.index("[ckpt] committed step_0000002")
+    step3_line = out.index("step       3 |")
+    assert initiated < step3_line < committed, (
+        "step 3 must run while step_0000002 is still uncommitted"
+    )
+    assert "training done: 4 optimizer steps" in out
+    for name in ("step_0000002", "step_0000004"):
+        path = tmp_path / "ckpt" / name
+        assert (path / "COMMITTED").exists(), name
+        assert verify_checkpoint(str(path)) == []
+
+
+@pytest.mark.slow  # two full CLI runs (~35s); poller + handler unit tests above cover the mechanism in the default suite
+def test_cli_poller_preemption_saves_committed_and_resumes(
+    capsys, shard_dir, tmp_path
+):
+    """Cloud-notice preemption end-to-end: the poller (file:// injection)
+    raises the shared flag, the driver emergency-saves a COMMITTED
+    checkpoint, exits rc 143, and a supervised --resume continues."""
+    with pytest.raises(SystemExit) as exc:
+        train_mod.main(
+            _common(shard_dir, tmp_path)
+            + ["--save_every", "100", "--max_steps", "6",
+               "--inject_preempt_notice_at", "3"]
+        )
+    assert exc.value.code == PREEMPTED_EXIT_CODE
+    out = capsys.readouterr().out
+    assert "[inject] cloud preemption notice after step 3" in out
+    assert "[preempt] cloud preemption notice (file://" in out
+    assert "[preempt] emergency checkpoint at step 3" in out
+    emergency = tmp_path / "ckpt" / "step_0000003"
+    assert (emergency / "COMMITTED").exists()
+    assert verify_checkpoint(str(emergency)) == []
+
+    out = run_cli(
+        capsys, *_common(shard_dir, tmp_path),
+        "--save_every", "100", "--max_steps", "6",
+        "--inject_preempt_notice_at", "3", "--resume",  # one-shot: no re-fire
+    )
+    assert "resumed from" in out and "step 3" in out
+    assert "training done: 6 optimizer steps" in out
+
+
+@pytest.mark.slow  # retry path is unit-covered by test_saver_retries_transient_failure_then_succeeds
+def test_cli_save_failure_retries_then_commits(capsys, shard_dir, tmp_path):
+    out = run_cli(
+        capsys, *_common(shard_dir, tmp_path),
+        "--save_every", "2", "--max_steps", "4",
+        "--inject_save_fail_at", "2", "--inject_save_fail_count", "1",
+        "--save_retry_backoff", "0.01",
+    )
+    assert "failed (attempt 1/" in out and "retrying" in out
+    assert "WARNING" not in out
+    assert "training done: 4 optimizer steps" in out
+    assert (tmp_path / "ckpt" / "step_0000002" / "COMMITTED").exists()
+
+
+@pytest.mark.slow  # degrade path is unit-covered by test_saver_exhausted_retries_degrade_without_raising
+def test_cli_save_failure_exhausted_degrades_to_metric(
+    capsys, shard_dir, tmp_path
+):
+    """Retries exhausted: the run keeps training (no crash), warns once, and
+    surfaces the gap as the save_failures metric on the CLI line."""
+    out = run_cli(
+        capsys, *_common(shard_dir, tmp_path),
+        "--save_every", "2", "--max_steps", "4",
+        "--inject_save_fail_at", "2", "--inject_save_fail_count", "3",
+        "--save_retries", "1", "--save_retry_backoff", "0.01",
+    )
+    assert "failed permanently after 2 attempts" in out
+    assert "training continues without this checkpoint" in out
+    assert "save_fail: 1" in out
+    assert "training done: 4 optimizer steps" in out
+    assert not (tmp_path / "ckpt" / "step_0000002").exists()
+    assert (tmp_path / "ckpt" / "step_0000004" / "COMMITTED").exists()
+
+
+@pytest.mark.slow  # GC semantics are unit-covered by test_gc_keep_last_n_never_removes_newest_committed
+def test_cli_keep_last_n_retention(capsys, shard_dir, tmp_path):
+    out = run_cli(
+        capsys, *_common(shard_dir, tmp_path),
+        "--save_every", "1", "--max_steps", "5", "--keep_last_n", "2",
+    )
+    assert "[ckpt] gc removed" in out
+    dirs = sorted(
+        d for d in os.listdir(tmp_path / "ckpt") if d.startswith("step_")
+    )
+    assert dirs == ["step_0000004", "step_0000005"]
+    assert "training done: 5 optimizer steps" in out

@@ -40,6 +40,44 @@ def test_supervise_passes_through_success():
     assert "restart" not in r.stderr
 
 
+def test_supervise_cleans_stale_uncommitted_dirs(tmp_path):
+    """A crash mid-async-save leaves step dirs with .INPROGRESS but no
+    COMMITTED; the wrapper removes them before (re)launching. Legacy dirs
+    (no markers) and committed dirs are untouched. Both --save_dir spellings
+    must be parsed."""
+    save_dir = tmp_path / "ckpt"
+    stale = save_dir / "step_0000005"
+    stale.mkdir(parents=True)
+    (stale / ".INPROGRESS").write_text("1\n")
+    committed = save_dir / "step_0000004"
+    committed.mkdir()
+    (committed / "COMMITTED").write_text("{}")
+    legacy = save_dir / "step_0000003"
+    legacy.mkdir()
+    (legacy / "meta.json").write_text("{}")
+
+    r = subprocess.run(
+        ["bash", SUPERVISE, "true", "--save_dir", str(save_dir)],
+        env=_env("0"), capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0
+    assert "removing stale uncommitted checkpoint" in r.stderr
+    assert "step_0000005" in r.stderr
+    assert not stale.exists()
+    assert committed.exists() and legacy.exists()
+
+    # --save_dir=DIR spelling; nothing stale left -> silent no-op.
+    (stale).mkdir()
+    (stale / ".INPROGRESS").write_text("1\n")
+    r = subprocess.run(
+        ["bash", SUPERVISE, "true", f"--save_dir={save_dir}"],
+        env=_env("0"), capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0
+    assert not stale.exists()
+    assert committed.exists() and legacy.exists()
+
+
 def test_supervise_bounded_restarts_then_gives_up():
     # A persistently failing command is relaunched MAX_RESTARTS times, then
     # the wrapper exits with the command's last rc (torchrun --max_restarts).
