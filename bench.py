@@ -505,8 +505,26 @@ def run_config(args, model: str, seq_len: int) -> dict:
             params, opt_state, metrics = step(params, opt_state, x, y, key, i)
         float(metrics.loss)  # materialize: full sync with the device
 
+        # Multi-host control-plane overhead (coordination.py), measured the
+        # way train.py pays it: one control-word exchange per step (inside
+        # the timed loop, identity fast path single-process) and one
+        # fingerprint allgather+compare, timed after a compile warmup. Both
+        # should read ~0 ms single-process — that's the pod-overhead claim.
+        from gpt_2_distributed_tpu.coordination import (
+            ConsensusBus,
+            check_fingerprints,
+            fingerprint_params,
+        )
+
+        bus = ConsensusBus()
+        check_fingerprints(fingerprint_params(params))  # jit warmup
+        t_fp = time.perf_counter()
+        check_fingerprints(fingerprint_params(params))
+        desync_check_ms = (time.perf_counter() - t_fp) * 1e3
+
         t0 = time.perf_counter()
         for i in range(steps):
+            bus.exchange(0)
             params, opt_state, metrics = step(
                 params, opt_state, x, y, key, args.warmup + i
             )
@@ -542,9 +560,12 @@ def run_config(args, model: str, seq_len: int) -> dict:
     peak = device_peak_flops()
     measured_mfu = mfu(tok_s_chip, config, seq_len, peak)
 
-    record_extra = {}
+    record_extra = {
+        "consensus_overhead_ms": round(bus.mean_exchange_ms, 4),
+        "desync_check_ms": round(desync_check_ms, 4),
+    }
     if saver is not None:
-        record_extra = {
+        record_extra |= {
             "ckpt_every": args.ckpt_every,
             "ckpt_async": getattr(args, "ckpt_async", "on") == "on",
             "ckpt_saves": len(ckpt_block_ms),

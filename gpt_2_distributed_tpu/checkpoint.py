@@ -498,6 +498,13 @@ class CheckpointSaver:
         # wait, to hold a checkpoint in the uncommitted state on purpose).
         self.pre_commit_hook: Callable[[str], None] | None = None
         self._commit_thread: threading.Thread | None = None
+        # Serializes the public entry points. Historically only the main
+        # thread called them; the hang watchdog (coordination.HangWatchdog)
+        # runs its best-effort emergency save on its own thread, which may
+        # race a main-thread save/drain that is itself wedged. RLock (not
+        # Lock): the commit thread never takes it, so wait() under the lock
+        # cannot self-deadlock, and re-entrant public calls stay legal.
+        self._api_lock = threading.RLock()
         self._ckptrs = None
         if self.policy.async_save:
             self._ckptrs = (
@@ -554,6 +561,7 @@ class CheckpointSaver:
         initiation failure)."""
         t0 = time.perf_counter()
         path = os.path.join(self.save_dir, step_dir_name(step))
+        self._api_lock.acquire()
         try:
             if not self.policy.async_save:
                 ok = self._with_retries(
@@ -591,6 +599,7 @@ class CheckpointSaver:
             self._commit_thread.start()
             return path
         finally:
+            self._api_lock.release()
             self.save_block_ms = (time.perf_counter() - t0) * 1e3
 
     def _save_and_commit_sync(self, path: str, step: int, params: Any,
@@ -661,23 +670,25 @@ class CheckpointSaver:
         same dir (wait-or-supersede: the in-flight save is drained first; if
         it already committed this exact step, done — otherwise write
         synchronously over/next to it)."""
-        self.wait()
-        path = os.path.join(self.save_dir, step_dir_name(step))
-        if step in self.committed_steps and is_committed_checkpoint(path):
-            return path
-        ok = self._with_retries(
-            step, f"emergency save {step_dir_name(step)}",
-            lambda: self._save_and_commit_sync(path, step, params,
-                                               opt_state, meta),
-        )
-        return path if ok else None
+        with self._api_lock:
+            self.wait()
+            path = os.path.join(self.save_dir, step_dir_name(step))
+            if step in self.committed_steps and is_committed_checkpoint(path):
+                return path
+            ok = self._with_retries(
+                step, f"emergency save {step_dir_name(step)}",
+                lambda: self._save_and_commit_sync(path, step, params,
+                                                   opt_state, meta),
+            )
+            return path if ok else None
 
     def close(self) -> None:
-        self.wait()
-        if self._ckptrs is not None:
-            for c in self._ckptrs:
-                c.close()
-            self._ckptrs = None
+        with self._api_lock:
+            self.wait()
+            if self._ckptrs is not None:
+                for c in self._ckptrs:
+                    c.close()
+                self._ckptrs = None
 
 
 def export_full_params(params: Any) -> dict[str, np.ndarray]:

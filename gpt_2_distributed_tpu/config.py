@@ -183,6 +183,39 @@ class CheckpointPolicy:
             )
 
 
+@dataclass(frozen=True)
+class CoordinationPolicy:
+    """Multi-host control-plane policy (``coordination.py``).
+
+    Run-level like :class:`CheckpointPolicy` — never participates in
+    jit/compile caching. All knobs are inert on a single process (consensus
+    and fingerprint checks are identity there), so defaults keep single-host
+    runs bit-identical to a build without the control plane.
+
+    * ``desync_check_every`` — allgather-and-compare a device-side parameter
+      fingerprint every N optimizer steps (0 = never). A mismatch names the
+      drifted ranks and routes into the rollback-to-last-verified path.
+    * ``hang_timeout_s`` — if no optimizer step completes within this window
+      the hang watchdog dumps stacks, attempts a bounded emergency save, and
+      exits ``resilience.HANG_EXIT_CODE`` for a supervised full-job restart
+      (0 = watchdog disabled, the default: timeouts must be sized to the
+      measured step time, which only the operator knows).
+    """
+
+    desync_check_every: int = 0
+    hang_timeout_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.desync_check_every < 0:
+            raise ValueError(
+                f"desync_check_every={self.desync_check_every} must be >= 0"
+            )
+        if self.hang_timeout_s < 0:
+            raise ValueError(
+                f"hang_timeout_s={self.hang_timeout_s} must be >= 0"
+            )
+
+
 # BASELINE.json configs 1-5 require these four sizes; the standard GPT-2 family.
 MODEL_PRESETS: dict[str, GPT2Config] = {
     "124M": GPT2Config(n_layer=12, n_embd=768, n_head=12),

@@ -38,6 +38,7 @@ import os
 import queue
 import random
 import threading
+import time
 from queue import Empty, Full
 from typing import Iterator, Sequence
 
@@ -99,11 +100,16 @@ class TokenShardDataset:
         num_workers: int = DEFAULT_NUM_WORKERS,
         vocab_size: int | None = None,
         shard_windows: bool = False,
+        data_read_retries: int = 2,
     ) -> None:
         if not shard_paths:
             raise ValueError("shard_paths is empty — no data to train on")
         if seq_len < 1:
             raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+        if data_read_retries < 0:
+            raise ValueError(
+                f"data_read_retries must be >= 0, got {data_read_retries}"
+            )
         if process_index is None or process_count is None:
             import jax
 
@@ -129,7 +135,37 @@ class TokenShardDataset:
         # would hand every host but one zero batches and force each host to
         # re-read the full val set, round-2 VERDICT weak-point #5).
         self.shard_windows = bool(shard_windows)
+        # Transient-I/O retry budget per read (GCS-FUSE / NFS flake shows up
+        # as EIO/ETIMEDOUT OSErrors on memmap open or page-in; a re-read
+        # usually succeeds). Corrupt-token ValueError is deliberately NOT
+        # retried — re-reading corrupt bytes cannot fix them. The counter is
+        # lock-protected: worker threads read concurrently, and the driver
+        # surfaces it as the data_read_retries metric.
+        self.data_read_retries = int(data_read_retries)
+        self.read_retry_count = 0
+        self._retry_lock = threading.Lock()
         self._epoch = 0
+
+    def _retry_io(self, fn, what: str):
+        """Run ``fn``, retrying transient ``OSError`` up to
+        ``data_read_retries`` times with doubling backoff."""
+        delay = 0.05
+        for attempt in range(self.data_read_retries + 1):
+            try:
+                return fn()
+            except OSError as exc:
+                if attempt == self.data_read_retries:
+                    raise
+                with self._retry_lock:
+                    self.read_retry_count += 1
+                print(
+                    f"[data] transient I/O error on {what} "
+                    f"({type(exc).__name__}: {exc}); retry "
+                    f"{attempt + 1}/{self.data_read_retries} in {delay:.2f}s",
+                    flush=True,
+                )
+                time.sleep(delay)
+                delay *= 2
 
     # Parity with the reference's set_epoch (``/root/reference/dataloader.py:162-171``).
     def set_epoch(self, epoch: int) -> None:
@@ -186,7 +222,9 @@ class TokenShardDataset:
         windows long-term should copy. ``start_offset_index`` slices the
         (deterministic) shuffled offset list for arithmetic resume.
         """
-        tokens = np.memmap(path, dtype="<u2", mode="r")
+        tokens = self._retry_io(
+            lambda: np.memmap(path, dtype="<u2", mode="r"), f"memmap {path}"
+        )
         n = tokens.shape[0]
         # Offset enumeration matches the reference exactly (stop at
         # n - (seq_len + 1); a shard of exactly seq_len + 1 tokens yields
@@ -219,7 +257,10 @@ class TokenShardDataset:
                 chunk = np.asarray(
                     remaining[c0 : c0 + _NATIVE_GATHER_CHUNK], dtype=np.int64
                 )
-                wins, max_id = native.gather_windows(tokens, chunk, window_len)
+                wins, max_id = self._retry_io(
+                    lambda: native.gather_windows(tokens, chunk, window_len),
+                    f"gather {path}",
+                )
                 if self.vocab_size is not None and max_id >= self.vocab_size:
                     # Error path: re-scan to name the offending offset, with
                     # the same message contract as the numpy path.
@@ -236,7 +277,10 @@ class TokenShardDataset:
             return
 
         for off in remaining:
-            window = np.array(tokens[off : off + window_len], dtype=np.uint16)
+            window = self._retry_io(
+                lambda: np.array(tokens[off : off + window_len], dtype=np.uint16),
+                f"read {path}",
+            )
             if self.vocab_size is not None:
                 top = int(window.max())
                 if top >= self.vocab_size:
@@ -363,17 +407,24 @@ class _WorkerThread(threading.Thread):
         batch_size: int,
         prefetch_factor: int,
         skip_samples: int = 0,
+        inject_fail_after: int = 0,
     ) -> None:
         super().__init__(daemon=True, name=f"shard-loader-{worker_id}")
         self.dataset = dataset
         self.worker_id = worker_id
         self.batch_size = batch_size
         self.skip_samples = skip_samples
+        # Fault injection (--inject_worker_fail_at): raise inside this worker
+        # thread after producing N batches, exercising the real
+        # _WorkerError -> consumer re-raise path (and, multi-host, the
+        # coordinated-abort consensus path) without faking the thread plumbing.
+        self.inject_fail_after = int(inject_fail_after)
         self.queue: queue.Queue = queue.Queue(maxsize=max(1, prefetch_factor))
         self._stop_event = threading.Event()
 
     def run(self) -> None:
         try:
+            produced = 0
             buf: list[np.ndarray] = []
             for sample in self.dataset.iter_worker(
                 self.worker_id, skip_samples=self.skip_samples
@@ -384,6 +435,12 @@ class _WorkerThread(threading.Thread):
                 if len(buf) == self.batch_size:
                     self._put(np.stack(buf))
                     buf = []
+                    produced += 1
+                    if self.inject_fail_after and produced >= self.inject_fail_after:
+                        raise RuntimeError(
+                            f"injected data-worker failure after "
+                            f"{produced} batches"
+                        )
             # drop_last=True: a trailing partial batch is discarded, matching
             # the reference's DataLoader(drop_last=True)
             # (``/root/reference/dataloader.py:208-217``).
@@ -436,6 +493,7 @@ class DataLoader:
         batch_size: int = DEFAULT_BATCH_SIZE,
         prefetch_factor: int = DEFAULT_PREFETCH_FACTOR,
         skip_batches: int = 0,
+        inject_worker_fail_after: int = 0,
     ) -> None:
         self.dataset = dataset
         self.batch_size = int(batch_size)
@@ -444,6 +502,8 @@ class DataLoader:
         # run skips already-consumed batches of the checkpointed epoch; later
         # epochs start from batch 0).
         self._pending_skip = int(skip_batches)
+        # Fault injection: worker 0 raises after producing N batches (0 = off).
+        self._inject_worker_fail_after = int(inject_worker_fail_after)
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         to_skip, self._pending_skip = self._pending_skip, 0
@@ -464,6 +524,9 @@ class DataLoader:
             _WorkerThread(
                 self.dataset, w, self.batch_size, self.prefetch_factor,
                 skip_samples=skipped[w] * self.batch_size,
+                inject_fail_after=(
+                    self._inject_worker_fail_after if w == 0 else 0
+                ),
             )
             for w in range(self.dataset.num_workers)
         ]
@@ -508,6 +571,7 @@ def create_dataloader(
     batch_size: int = DEFAULT_BATCH_SIZE,
     prefetch_factor: int = DEFAULT_PREFETCH_FACTOR,
     skip_batches: int = 0,
+    inject_worker_fail_after: int = 0,
 ) -> DataLoader:
     """Factory mirroring the reference's ``create_dataloader``
     (``/root/reference/dataloader.py:174-219``)."""
@@ -516,4 +580,5 @@ def create_dataloader(
         batch_size=batch_size,
         prefetch_factor=prefetch_factor,
         skip_batches=skip_batches,
+        inject_worker_fail_after=inject_worker_fail_after,
     )

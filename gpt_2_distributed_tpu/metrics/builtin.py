@@ -89,6 +89,24 @@ METRIC_REGISTRY.metric(
     cli_format="save_fail: {value:.0f}",
 )(lambda v: float(int(v)))
 
+# Multi-host control plane (coordination.py): cumulative count of desync
+# detections — fingerprint-allgather rounds where at least one host's
+# parameter fingerprint disagreed with the pod. Each detection routes into
+# the rollback-to-last-verified path; pushed only once nonzero.
+METRIC_REGISTRY.metric(
+    "desync_detected", reduction=ReductionStrategy.CURRENT,
+    cli_format="desync: {value:.0f}",
+)(lambda v: float(int(v)))
+
+# Data pipeline (data/dataloader.py): cumulative count of transient shard-I/O
+# retries (OSError on memmap open/read, re-read succeeded or is about to be
+# re-attempted). Non-zero means the storage layer is flaky but survivable;
+# pushed only once nonzero.
+METRIC_REGISTRY.metric(
+    "data_read_retries", reduction=ReductionStrategy.CURRENT,
+    cli_format="io_retry: {value:.0f}",
+)(lambda v: float(int(v)))
+
 # Periodic validation loss over the held-out shard (shard 0 is reserved as
 # "val" by the tokenizer pipeline, notebook cell 13 convention). The reference
 # reserves the split but never consumes it; the TPU build's --eval_every wires

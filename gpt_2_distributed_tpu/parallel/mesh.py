@@ -52,8 +52,18 @@ def init_distributed(
     env fallbacks, mirroring the reference's launcher contract
     (``/root/reference/scripts/run_training_distributed_fsdp_main.sh:15-20``):
     MASTER_ADDR:MASTER_PORT, WORLD_SIZE, RANK. No-op for single-process runs
-    when no coordinator can be determined.
+    when no coordinator can be determined. Idempotent once the distributed
+    runtime is live: ``jax.distributed.initialize`` raises if called twice,
+    and in-process drivers (the multihost test workers calling
+    ``train.main()`` after their own bootstrap) must be able to pass through.
+    The liveness probe reads the distributed client's state directly —
+    ``jax.process_count()`` would itself initialize the backends, which
+    forbids a later ``jax.distributed.initialize``.
     """
+    from jax._src import distributed as _jax_distributed
+
+    if getattr(_jax_distributed.global_state, "client", None) is not None:
+        return
     if coordinator_address is None:
         addr = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("MASTER_ADDR")
         port = os.environ.get("MASTER_PORT", "12355")
@@ -82,6 +92,12 @@ def init_distributed(
             return
         jax.distributed.initialize()
         return
+    if str(jax.config.jax_platforms or "").startswith("cpu"):
+        # Cross-process collectives on the CPU backend need the gloo
+        # transport; the default implementation aborts every multi-process
+        # computation with "Multiprocess computations aren't implemented on
+        # the CPU backend". Must be set before backend initialization.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -315,6 +315,12 @@ def verify_checkpoint(path: str) -> list[str]:
 
 PREEMPTED_EXIT_CODE = 143  # 128 + SIGTERM: the conventional "killed by TERM" rc
 
+# Multi-host control-plane exit codes (coordination.py). Both are restarts
+# that BURN a supervise.sh attempt, unlike preemption's free rc 143: a hang
+# or a data-worker death is a fault, not scheduled infrastructure churn.
+HANG_EXIT_CODE = 170        # hang watchdog fired: no step within --hang_timeout_s
+DATA_ABORT_EXIT_CODE = 171  # pod-wide coordinated abort: a data worker died
+
 
 class PreemptionHandler:
     """SIGTERM -> flag, checked by the driver at each optimizer-step boundary.

@@ -29,7 +29,7 @@ from typing import Any
 
 import numpy as np
 
-from gpt_2_distributed_tpu.config import MODEL_PRESETS
+from gpt_2_distributed_tpu.config import MODEL_PRESETS, CoordinationPolicy
 from gpt_2_distributed_tpu.ops.losses import DEFAULT_BLOCK_ROWS
 from gpt_2_distributed_tpu.data.dataloader import (
     DEFAULT_BATCH_SIZE,
@@ -243,6 +243,56 @@ def build_parser() -> argparse.ArgumentParser:
         "One-shot marker in --save_dir. 0 = off; requires --save_dir.",
     )
     p.add_argument(
+        "--desync_check_every", type=int, default=0,
+        help="multi-host control plane (coordination.py): every N optimizer "
+        "steps, allgather and compare a cheap device-side parameter "
+        "fingerprint across hosts; a mismatch names the drifted ranks, "
+        "counts in the desync_detected metric, and rolls the whole pod back "
+        "to the last verified checkpoint. 0 = off. Identity single-process.",
+    )
+    p.add_argument(
+        "--hang_timeout_s", type=float, default=0.0,
+        help="hang watchdog (coordination.py): if no optimizer step "
+        "completes within this many seconds, dump all-thread stacks, "
+        "attempt a bounded best-effort emergency save, and exit rc 170 for "
+        "a supervised FULL-JOB restart (burns a restart attempt, unlike "
+        "preemption's rc 143). Size it well above the worst-case step time; "
+        "the watchdog arms only once the first step completes, so initial "
+        "compilation is excluded. 0 = off (default).",
+    )
+    p.add_argument(
+        "--data_read_retries", type=int, default=2,
+        help="retry transient shard-I/O errors (OSError on memmap open/read "
+        "— GCS-FUSE/NFS flake) this many times with doubling backoff before "
+        "failing the epoch; counted in the data_read_retries metric. "
+        "Corrupt-token errors are never retried.",
+    )
+    p.add_argument(
+        "--inject_desync_at", type=int, default=0,
+        help="fault injection: multiply the LAST rank's params by 1.001 "
+        "just before optimizer step N (symmetric dispatch, rank-conditional "
+        "value — the injection cannot itself deadlock the collectives it "
+        "tests), exercising the --desync_check_every detector end-to-end "
+        "on CPU. One-shot marker in --save_dir when set. 0 = off; requires "
+        "--desync_check_every.",
+    )
+    p.add_argument(
+        "--inject_hang_at", type=int, default=0,
+        help="fault injection: rank 0 sleeps inside the step loop just "
+        "before optimizer step N, exercising the --hang_timeout_s watchdog "
+        "(every rank exits rc 170 — the hung rank from its own sleep, its "
+        "peers from the collective it never joins). One-shot. 0 = off; "
+        "requires --hang_timeout_s > 0.",
+    )
+    p.add_argument(
+        "--inject_worker_fail_at", type=int, default=0,
+        help="fault injection: data worker 0 on rank 0 raises after "
+        "producing N batches, exercising worker-error propagation (single-"
+        "process: loud RuntimeError, unchanged; multi-host: pod-wide "
+        "coordinated abort rc 171 instead of N-1 hosts deadlocked). "
+        "One-shot. 0 = off.",
+    )
+    p.add_argument(
         "--remat", nargs="?", const="block", default=False,
         choices=["block", "mlp", "attn", "dots"],
         help="activation checkpointing: 'block' (full, lowest memory; the "
@@ -378,6 +428,17 @@ def main(argv: list[str] | None = None) -> None:
         build_parser().error("--inject_preempt_notice_at needs --save_dir (notice file + one-shot marker)")
     if args.guard_max_grad_norm and args.step_guard != "on":
         build_parser().error("--guard_max_grad_norm requires --step_guard on (the clip fallback lives inside the guarded step)")
+    if args.inject_hang_at and args.hang_timeout_s <= 0:
+        build_parser().error("--inject_hang_at requires --hang_timeout_s > 0 (otherwise the injected hang sleeps unwatched)")
+    if args.inject_desync_at and not args.desync_check_every:
+        build_parser().error("--inject_desync_at requires --desync_check_every > 0 (nothing would ever detect the injected divergence)")
+    try:
+        coord_policy = CoordinationPolicy(
+            desync_check_every=args.desync_check_every,
+            hang_timeout_s=args.hang_timeout_s,
+        )
+    except ValueError as e:
+        build_parser().error(str(e))
 
     # Honor --device (highest priority) then JAX_PLATFORMS, even when a site
     # boot hook force-registered a different backend before us (observed: an
@@ -405,12 +466,22 @@ def main(argv: list[str] | None = None) -> None:
     from gpt_2_distributed_tpu import checkpoint as ckpt
     from gpt_2_distributed_tpu.config import CheckpointPolicy
     from gpt_2_distributed_tpu.resilience import (
+        DATA_ABORT_EXIT_CODE,
         PREEMPTED_EXIT_CODE,
         SKIP_REASON_NAMES,
         PreemptionHandler,
         PreemptionPoller,
         SpikeMonitor,
         init_guard_state,
+    )
+    from gpt_2_distributed_tpu.coordination import (
+        ConsensusBus,
+        HangWatchdog,
+        check_fingerprints,
+        decode_control_word,
+        encode_control_word,
+        fingerprint_params,
+        perturb_params,
     )
     from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
     from gpt_2_distributed_tpu.models import gpt2
@@ -465,6 +536,7 @@ def main(argv: list[str] | None = None) -> None:
         seq_len=args.seq_len,
         num_workers=args.workers,
         vocab_size=config.vocab_size,
+        data_read_retries=args.data_read_retries,
     )
     # One optimizer step consumes grad_accum local micro-batches. The count
     # feeds the cosine schedule's decay horizon, so it must be the
@@ -643,6 +715,7 @@ def main(argv: list[str] | None = None) -> None:
                 eval_dataset = TokenShardDataset(
                     val_paths, seq_len=args.seq_len, num_workers=1,
                     vocab_size=config.vocab_size, shard_windows=True,
+                    data_read_retries=args.data_read_retries,
                 )
                 eval_dataset.set_epoch(0)
                 eval_step = make_eval_step(config)
@@ -712,8 +785,39 @@ def main(argv: list[str] | None = None) -> None:
                 handler=preempt,
             ).start()
 
+        # --- multi-host control plane (coordination.py) ---------------------
+        # Fault DECISIONS must be as symmetric as the collectives they gate:
+        # each step every process contributes a control word (preempt,
+        # rollback, skip, worker-error, save-now) to an OR-reduce, and the
+        # pod acts on the AGREED word — same action, same step, every host.
+        # Identity fast path single-process: bus.exchange never allgathers,
+        # and every multihost-only branch below is skipped outright.
+        bus = ConsensusBus()
+        multihost = bus.process_count > 1
+        desync_count = 0
+        skip_observed_last = False
+
+        watchdog = None
+        if coord_policy.hang_timeout_s > 0:
+
+            def _watchdog_emergency_save() -> None:
+                # Process-local best effort: the pod is presumed wedged, so
+                # an orbax save whose write spans processes may never finish
+                # — the watchdog abandons it after its grace window.
+                if saver is not None:
+                    saver.ensure_committed_sync(
+                        global_step, params, opt_state,
+                        make_meta(global_step, epoch, step_in_epoch),
+                    )
+
+            watchdog = HangWatchdog(
+                coord_policy.hang_timeout_s, on_hang=_watchdog_emergency_save,
+            ).start()
+
         def stop_aux() -> None:
             """Quiesce the background machinery at every exit path."""
+            if watchdog is not None:
+                watchdog.stop()
             if poller is not None:
                 poller.stop()
             if saver is not None:
@@ -734,6 +838,7 @@ def main(argv: list[str] | None = None) -> None:
 
         def flush_pending() -> None:
             nonlocal pending, rollback_requested, last_skip_reason_host
+            nonlocal skip_observed_last
             if pending is None:
                 return
             p_step, p_epoch, p_batch, p_m = pending
@@ -741,6 +846,10 @@ def main(argv: list[str] | None = None) -> None:
             extra = {}
             if use_guard:
                 reason = int(p_m.skip_reason)
+                # Fed to the next consensus exchange: the guard's decision is
+                # computed from globally-reduced values, so hosts disagreeing
+                # on it is itself a desync signal (warned on below).
+                skip_observed_last = bool(reason)
                 if reason:
                     last_skip_reason_host = reason
                     if is_primary():
@@ -784,6 +893,10 @@ def main(argv: list[str] | None = None) -> None:
                     )
             if saver is not None and saver.failed_saves:
                 extra["save_failures"] = saver.failed_saves
+            if desync_count:
+                extra["desync_detected"] = desync_count
+            if dataset.read_retry_count:
+                extra["data_read_retries"] = dataset.read_retry_count
             # p_step is the post-increment global step; optax evaluated the
             # schedule at count p_step - 1 for that update, so log that one.
             # A skipped step's loss/grad_norm are the REJECTED values (the
@@ -798,10 +911,71 @@ def main(argv: list[str] | None = None) -> None:
                 values["grad_norm"] = float(p_m.grad_norm)
             tracker.update(p_step, **values, **extra)
 
+        def emergency_preempt_exit() -> None:
+            """Preemption endgame (single-host: SIGTERM/poller flag at the
+            step boundary; multi-host: the pod-AGREED preempt bit): flush,
+            commit one emergency checkpoint, quiesce, exit rc 143 — the rc
+            supervise.sh relaunches without burning a restart attempt."""
+            flush_pending()
+            if args.profile and args.log_dir:
+                jax.profiler.stop_trace()
+            if watchdog is not None:
+                watchdog.disarm()
+            if saver is not None:
+                # wait-or-supersede: drains any in-flight async
+                # save first; never two writers in one step dir.
+                saver.ensure_committed_sync(
+                    global_step, params, opt_state,
+                    make_meta(global_step, epoch, step_in_epoch),
+                )
+            tracker.close()
+            stop_aux()
+            preempt.uninstall()
+            if is_primary():
+                print(
+                    f"[preempt] emergency checkpoint at step "
+                    f"{global_step}; exiting rc "
+                    f"{PREEMPTED_EXIT_CODE} for a supervised resume",
+                    flush=True,
+                )
+            raise SystemExit(PREEMPTED_EXIT_CODE)
+
+        def coordinated_worker_abort(exc: BaseException | None) -> None:
+            """Pod-agreed abort: a data worker died on some host. Every
+            process reaches this from the SAME step's consensus exchange, so
+            the emergency save's collectives line up; then exit a distinct
+            rc that supervise.sh treats as a fault (burns an attempt —
+            a worker death is not scheduled churn)."""
+            flush_pending()
+            if args.profile and args.log_dir:
+                jax.profiler.stop_trace()
+            if watchdog is not None:
+                watchdog.disarm()
+            if saver is not None:
+                saver.ensure_committed_sync(
+                    global_step, params, opt_state,
+                    make_meta(global_step, epoch, step_in_epoch),
+                )
+            tracker.close()
+            stop_aux()
+            preempt.uninstall()
+            detail = f" ({exc})" if exc is not None else " (on a peer host)"
+            print(
+                f"[coord] data worker failed{detail}; pod-wide coordinated "
+                f"abort at step {global_step}, exiting rc "
+                f"{DATA_ABORT_EXIT_CODE}",
+                flush=True,
+            )
+            raise SystemExit(DATA_ABORT_EXIT_CODE)
+
         done = False
         rollbacks_done = 0
         fired: set = set()  # in-process one-shot injections (no --save_dir)
         epoch, step_in_epoch = start_epoch, skip_steps
+        # Multi-host periodic saves happen at the step boundary AFTER the
+        # consensus exchange (so the decision to save is pod-agreed); this
+        # guards against re-saving the step a resume/rollback restored.
+        last_saved_step = global_step
         while True:
             rollback_requested = False
             for epoch in range(start_epoch, args.epochs):
@@ -812,6 +986,20 @@ def main(argv: list[str] | None = None) -> None:
                     batch_size=local_batch,
                     prefetch_factor=args.prefetch_factor,
                     skip_batches=(skip_steps * args.grad_accum_steps) if epoch == start_epoch else 0,
+                    inject_worker_fail_after=(
+                        args.inject_worker_fail_at
+                        if (
+                            args.inject_worker_fail_at
+                            and jax.process_index() == 0
+                            and _claim_one_shot(
+                                args.save_dir,
+                                f"worker_fail_injected_"
+                                f"{args.inject_worker_fail_at}",
+                                fired,
+                            )
+                        )
+                        else 0
+                    ),
                 )
                 step_in_epoch = skip_steps if epoch == start_epoch else 0
 
@@ -828,12 +1016,165 @@ def main(argv: list[str] | None = None) -> None:
                 )
 
                 micro: list[tuple[np.ndarray, np.ndarray]] = []
-                for xb, yb in loader:
-                    if step_in_epoch >= epoch_opt_steps:
-                        break
-                    micro.append((xb, yb))
-                    if len(micro) < args.grad_accum_steps:
-                        continue
+                loader_iter = iter(loader)
+                worker_error: BaseException | None = None
+                while step_in_epoch < epoch_opt_steps:
+                    # (1) Host-local fetch of one optimizer step's
+                    # micro-batches. Deliberately NOT a collective: a host
+                    # whose data worker just died still reaches the consensus
+                    # exchange below, so the pod agrees to abort together
+                    # instead of leaving the other N-1 hosts wedged forever
+                    # in the train step's psum.
+                    if worker_error is None:
+                        try:
+                            while len(micro) < args.grad_accum_steps:
+                                xb, yb = next(loader_iter)
+                                micro.append((xb, yb))
+                        except StopIteration:
+                            break
+                        except RuntimeError as exc:
+                            if not multihost:
+                                raise  # single-process: fail loudly, unchanged
+                            worker_error = exc
+                            # Surface the chained root cause: the loader wraps
+                            # worker deaths in a generic "data worker N failed"
+                            # and the actionable error rides on __cause__.
+                            cause = exc.__cause__
+                            detail = f"{exc}: {cause}" if cause else str(exc)
+                            print(
+                                f"[coord] local data worker failed ({detail}); "
+                                f"requesting pod-wide abort",
+                                flush=True,
+                            )
+
+                    # (2) Desync detector: symmetric by construction (every
+                    # host agrees on global_step), so the allgather inside
+                    # always pairs up — even when this host is carrying a
+                    # worker error to the exchange below.
+                    if (
+                        multihost
+                        and coord_policy.desync_check_every
+                        and global_step > 0
+                        and global_step % coord_policy.desync_check_every == 0
+                    ):
+                        t_fp = time.perf_counter()
+                        bad_ranks = check_fingerprints(
+                            fingerprint_params(params)
+                        )
+                        if bad_ranks:
+                            desync_count += 1
+                            rollback_requested = True
+                            if is_primary():
+                                print(
+                                    f"[coord] DESYNC at step {global_step}: "
+                                    f"rank(s) {bad_ranks} disagree with the "
+                                    f"pod's parameter fingerprint (check "
+                                    f"took "
+                                    f"{(time.perf_counter() - t_fp) * 1e3:.1f}"
+                                    f" ms); rolling back to the last "
+                                    f"verified checkpoint",
+                                    flush=True,
+                                )
+
+                    # (3) Consensus exchange: OR-reduce the per-host control
+                    # words and act on the AGREED word — the only place fault
+                    # flags turn into actions on a pod.
+                    if multihost:
+                        agreed = decode_control_word(bus.exchange(
+                            encode_control_word(
+                                preempt=preempt.preempted(),
+                                rollback=rollback_requested,
+                                skip=skip_observed_last,
+                                worker_error=worker_error is not None,
+                                save_now=bool(
+                                    saver is not None and saver.failed_saves
+                                ),
+                            )
+                        ))
+                        if agreed.worker_error:
+                            coordinated_worker_abort(worker_error)
+                        if agreed.preempt:
+                            emergency_preempt_exit()
+                        if agreed.skip and not skip_observed_last:
+                            print(
+                                f"[coord] step {global_step}: another host "
+                                f"observed a guard skip this host did not — "
+                                f"guard inputs may have diverged",
+                                flush=True,
+                            )
+                        if agreed.rollback:
+                            rollback_requested = True
+                            if is_primary():
+                                print(
+                                    f"[coord] pod-agreed rollback before "
+                                    f"step {global_step + 1}",
+                                    flush=True,
+                                )
+                            break
+                        # Pod-agreed periodic/make-up save at this boundary
+                        # (params here are identical to post-dispatch of the
+                        # previous step). Single-process keeps its original
+                        # post-dispatch save block below, bit-identical.
+                        if (
+                            saver is not None
+                            and global_step > 0
+                            and global_step != last_saved_step
+                            and (
+                                agreed.save_now
+                                or (
+                                    args.save_every
+                                    and global_step % args.save_every == 0
+                                )
+                            )
+                        ):
+                            saver.save(
+                                global_step, params, opt_state,
+                                make_meta(global_step, epoch, step_in_epoch),
+                            )
+                            last_saved_step = global_step
+
+                    # Fault injections for the control plane itself.
+                    if (
+                        args.inject_desync_at
+                        and global_step + 1 == args.inject_desync_at
+                        and _claim_one_shot(
+                            args.save_dir,
+                            f"desync_injected_{args.inject_desync_at}",
+                            fired,
+                        )
+                    ):
+                        factor = np.float32(
+                            1.001
+                            if jax.process_index() == jax.process_count() - 1
+                            else 1.0
+                        )
+                        params = perturb_params(params, factor)
+                        print(
+                            f"[inject] desync perturbation x{float(factor):g} "
+                            f"on rank {jax.process_index()} before step "
+                            f"{global_step + 1}",
+                            flush=True,
+                        )
+                    if (
+                        args.inject_hang_at
+                        and global_step + 1 == args.inject_hang_at
+                        and jax.process_index() == 0
+                        and _claim_one_shot(
+                            args.save_dir,
+                            f"hang_injected_{args.inject_hang_at}",
+                            fired,
+                        )
+                    ):
+                        print(
+                            f"[inject] simulated hang before step "
+                            f"{global_step + 1}; the watchdog should fire "
+                            f"within {coord_policy.hang_timeout_s:g}s",
+                            flush=True,
+                        )
+                        # The watchdog's os._exit cuts this sleep short; the
+                        # horizon only matters if the watchdog is broken.
+                        time.sleep(coord_policy.hang_timeout_s * 20 + 30)
+
                     x = np.stack([m[0] for m in micro])
                     y = np.stack([m[1] for m in micro])
                     micro = []
@@ -867,24 +1208,39 @@ def main(argv: list[str] | None = None) -> None:
                     step_in_epoch += 1
                     flush_pending()
                     pending = (global_step, epoch, step_in_epoch, m)
-                    if rollback_requested:
+                    if watchdog is not None:
+                        # Arm-as-beat: the deadline extends only when a step
+                        # completes, and the watchdog goes live only after the
+                        # FIRST completed step — initial compilation is
+                        # excluded from the hang budget.
+                        watchdog.arm()
+                    # Multi-host defers every local fault decision below to
+                    # the next step's consensus exchange, so all hosts act
+                    # identically on the identical step (one-step lag).
+                    if rollback_requested and not multihost:
                         break
 
                     if run_eval is not None and global_step % args.eval_every == 0:
                         flush_pending()
+                        if watchdog is not None:
+                            watchdog.disarm()  # eval has no step cadence
                         # count_tokens=False: this step's training update
                         # already counted its tokens; eval is out-of-band.
                         tracker.update(
                             global_step, count_tokens=False,
                             eval_loss=run_eval(params),
                         )
+                        if watchdog is not None:
+                            watchdog.arm()
                     if (
-                        args.save_dir and args.save_every
+                        not multihost
+                        and args.save_dir and args.save_every
                         and global_step % args.save_every == 0
                     ):
                         flush_pending()
                     if (
-                        args.save_dir and args.save_every
+                        not multihost
+                        and args.save_dir and args.save_every
                         and global_step % args.save_every == 0
                         # re-checked AFTER the flush: never checkpoint a step
                         # the spike monitor just flagged for rollback — the
@@ -895,7 +1251,7 @@ def main(argv: list[str] | None = None) -> None:
                             global_step, params, opt_state,
                             make_meta(global_step, epoch, step_in_epoch),
                         )
-                    if rollback_requested:
+                    if rollback_requested and not multihost:
                         break
                     if args.inject_fail_at and global_step >= args.inject_fail_at:
                         marker = os.path.join(
@@ -961,31 +1317,23 @@ def main(argv: list[str] | None = None) -> None:
                             and time.monotonic() < deadline
                         ):
                             time.sleep(0.01)
-                    if preempt.preempted():
-                        flush_pending()
-                        if args.profile and args.log_dir:
-                            jax.profiler.stop_trace()
-                        if saver is not None:
-                            # wait-or-supersede: drains any in-flight async
-                            # save first; never two writers in one step dir.
-                            saver.ensure_committed_sync(
-                                global_step, params, opt_state,
-                                make_meta(global_step, epoch, step_in_epoch),
-                            )
-                        tracker.close()
-                        stop_aux()
-                        preempt.uninstall()
-                        if is_primary():
-                            print(
-                                f"[preempt] emergency checkpoint at step "
-                                f"{global_step}; exiting rc "
-                                f"{PREEMPTED_EXIT_CODE} for a supervised resume",
-                                flush=True,
-                            )
-                        raise SystemExit(PREEMPTED_EXIT_CODE)
+                    if not multihost and preempt.preempted():
+                        emergency_preempt_exit()
                     if args.max_steps and global_step >= args.max_steps:
                         done = True
                         break
+                loader_iter.close()  # stop worker threads promptly
+                if multihost:
+                    # Epoch/run boundary barrier: a fault flag raised by the
+                    # very last step's flush would otherwise be consumed
+                    # asymmetrically (one host entering the rollback path's
+                    # collectives while another starts the next epoch). Every
+                    # while-exit above is symmetric, so this exchange always
+                    # pairs up.
+                    agreed = decode_control_word(bus.exchange(
+                        encode_control_word(rollback=rollback_requested)
+                    ))
+                    rollback_requested = agreed.rollback
                 if done or rollback_requested:
                     break
                 skip_steps = 0  # later epochs start from batch 0
@@ -996,7 +1344,12 @@ def main(argv: list[str] | None = None) -> None:
                 # offending batches, via the loader's O(1) skip), reset the
                 # guard counters and spike baseline, and go again.
                 pending = None
-                monitor.reset()
+                if watchdog is not None:
+                    watchdog.disarm()  # restore has no step cadence
+                if monitor is not None:
+                    # A desync-triggered rollback can arrive with the spike
+                    # monitor disabled (--step_guard off).
+                    monitor.reset()
                 guard_state = init_guard_state()
                 rollbacks_done += 1
                 if rollbacks_done > args.max_rollbacks:
@@ -1033,6 +1386,7 @@ def main(argv: list[str] | None = None) -> None:
                     continue
                 params, opt_state, meta, rpath = restored
                 global_step = meta.step
+                last_saved_step = global_step  # never re-save the restored step
                 tracker.total_tokens = meta.total_tokens
                 if is_primary():
                     print(
@@ -1047,6 +1401,8 @@ def main(argv: list[str] | None = None) -> None:
 
         # --- teardown ---------------------------------------------------------
         flush_pending()
+        if watchdog is not None:
+            watchdog.disarm()  # the final sync save has no step cadence
         preempt.uninstall()
         if args.profile and args.log_dir:
             jax.profiler.stop_trace()

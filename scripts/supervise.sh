@@ -77,6 +77,22 @@ while :; do
         sleep "$RESTART_DELAY"
         continue
     fi
+    if [ "$rc" -eq 170 ]; then
+        # Hang watchdog (coordination.HangWatchdog, resilience.HANG_EXIT_CODE):
+        # no optimizer step completed within --hang_timeout_s — a collective
+        # deadlock or a dead peer host. A full-job restart is the recovery,
+        # but unlike preemption this IS a fault, so it burns an attempt
+        # (a job that hangs every time must not restart forever).
+        echo "[supervise] hang watchdog fired (rc=170); restarting the job" \
+             "(counts against MAX_RESTARTS)" >&2
+    fi
+    if [ "$rc" -eq 171 ]; then
+        # Pod-wide coordinated data-worker abort (resilience
+        # DATA_ABORT_EXIT_CODE): every host saved and exited together instead
+        # of N-1 hosts deadlocking. Burns an attempt, same rationale as 170.
+        echo "[supervise] data-worker abort (rc=171); restarting the job" \
+             "(counts against MAX_RESTARTS)" >&2
+    fi
     attempt=$((attempt + 1))
     if [ "$attempt" -gt "$MAX_RESTARTS" ]; then
         echo "[supervise] giving up after ${MAX_RESTARTS} restarts (last rc=${rc})" >&2

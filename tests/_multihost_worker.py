@@ -78,6 +78,69 @@ def main() -> None:
     assert len(jax.local_devices()) == 4
 
     rank = jax.process_index()
+
+    # --- control-plane phases (coordination.py) drive the REAL training CLI
+    # in-process: train.main() re-enters init_distributed (idempotent) and
+    # runs the full step loop with the consensus bus live across this pair.
+    if phase == "consensus_spike":
+        # Rank 1's spike monitor alone demands a rollback; the consensus
+        # exchange must roll BOTH ranks back at the same step boundary.
+        import io
+        from contextlib import redirect_stdout
+
+        from gpt_2_distributed_tpu import resilience, train
+
+        calls = {"observe": 0, "reset": 0}
+        orig_observe = resilience.SpikeMonitor.observe
+        orig_reset = resilience.SpikeMonitor.reset
+
+        def fake_observe(self, loss, skipped=False):
+            calls["observe"] += 1
+            if rank == 1 and calls["observe"] == 3:
+                return "rollback"  # force it on rank 1 ONLY, step 3's flush
+            return orig_observe(self, loss, skipped=skipped)
+
+        def counting_reset(self):
+            # The rollback path's tell on every rank. __init__ also calls
+            # reset() (before the attributes exist) — don't count that one.
+            if hasattr(self, "n_healthy"):
+                calls["reset"] += 1
+            return orig_reset(self)
+
+        resilience.SpikeMonitor.observe = fake_observe
+        resilience.SpikeMonitor.reset = counting_reset
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            train.main(json.loads(os.environ["TRAIN_ARGV"]))
+        out = buf.getvalue()
+        record = {
+            "rank": rank,
+            "observe_calls": calls["observe"],
+            "resets": calls["reset"],
+            "pod_agreed": "[coord] pod-agreed rollback before step 5" in out,
+            "continued_in_place": "continuing in place" in out,
+            "done": "training done: 6 optimizer steps" in out,
+        }
+        print(json.dumps(record))
+        sys.stdout.flush()
+        jax.distributed.shutdown()
+        return
+
+    if phase == "train_cli":
+        # Generic CLI phase: argv from the environment (plus rank-conditional
+        # extras), exits propagated verbatim — the parent asserts the process
+        # rc (143/170/171) and greps stdout/stderr.
+        from gpt_2_distributed_tpu import train
+
+        argv = json.loads(os.environ["TRAIN_ARGV"]) + json.loads(
+            os.environ.get(f"TRAIN_ARGV_RANK{rank}", "[]")
+        )
+        train.main(argv)
+        print(json.dumps({"rank": rank, "rc": 0}))
+        sys.stdout.flush()
+        jax.distributed.shutdown()
+        return
+
     config = GPT2Config(
         vocab_size=257, n_positions=64, n_embd=32, n_layer=2, n_head=2,
         embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,

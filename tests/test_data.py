@@ -202,6 +202,83 @@ def test_skip_batches_is_arithmetic_not_read(shard_dir, monkeypatch):
     )
 
 
+def test_transient_io_error_retried_once(shard_dir, monkeypatch):
+    """A single OSError on memmap open (GCS-FUSE/NFS flake) is retried and
+    the epoch completes; the retry is counted for the data_read_retries
+    metric."""
+    import gpt_2_distributed_tpu.data.dataloader as dl_mod
+
+    ds = _dataset(shard_dir, num_workers=1, data_read_retries=2)
+    real_memmap = np.memmap
+    failures = iter([True])  # first open fails, everything after succeeds
+
+    def flaky_memmap(path, *a, **k):
+        if next(failures, False):
+            raise OSError("simulated EIO on page-in")
+        return real_memmap(path, *a, **k)
+
+    monkeypatch.setattr(dl_mod.np, "memmap", flaky_memmap)
+    n = sum(1 for _ in create_dataloader(ds, batch_size=4))
+    assert n == ds.batches_per_epoch(4)
+    assert ds.read_retry_count == 1
+
+
+def test_transient_io_retries_exhausted_propagates(shard_dir, monkeypatch):
+    import gpt_2_distributed_tpu.data.dataloader as dl_mod
+
+    ds = _dataset(shard_dir, num_workers=1, data_read_retries=1)
+
+    def always_fails(path, *a, **k):
+        raise OSError("persistent EIO")
+
+    monkeypatch.setattr(dl_mod.np, "memmap", always_fails)
+    with pytest.raises(RuntimeError, match="data worker"):
+        for _ in iter(create_dataloader(ds, batch_size=4)):
+            pass
+    # 1 retry per failed open, then the OSError propagates.
+    assert ds.read_retry_count >= 1
+
+
+def test_corrupt_token_error_not_retried(tmp_path):
+    """ValueError (token id >= vocab_size) is a data bug, not flake —
+    re-reading corrupt bytes cannot fix them, so it must fail immediately
+    with zero retries."""
+    d = str(tmp_path)
+    tokens = np.zeros(4096, dtype="<u2")
+    tokens[100] = 5000  # out of the vocab below
+    tokens.tofile(os.path.join(d, "bad_train_000001.bin"))
+    ds = TokenShardDataset(
+        get_shard_paths(d, "train"), seq_len=63, process_index=0,
+        process_count=1, num_workers=1, vocab_size=257, data_read_retries=5,
+    )
+    with pytest.raises(ValueError, match="vocab_size"):
+        for _ in ds.iter_worker(0):
+            pass
+    assert ds.read_retry_count == 0
+
+
+def test_data_read_retries_validation(shard_dir):
+    with pytest.raises(ValueError, match="data_read_retries"):
+        _dataset(shard_dir, data_read_retries=-1)
+
+
+def test_inject_worker_fail_surfaces_as_worker_error(shard_dir):
+    """--inject_worker_fail_at plumbing: worker 0 raises after producing N
+    batches and the consumer sees the standard worker-error RuntimeError (the
+    same path a real worker death takes)."""
+    ds = _dataset(shard_dir, num_workers=2)
+    loader = create_dataloader(ds, batch_size=4, inject_worker_fail_after=2)
+    got = 0
+    with pytest.raises(RuntimeError, match="data worker 0 failed") as ei:
+        for _ in iter(loader):
+            got += 1
+    assert "injected data-worker failure after 2 batches" in str(
+        ei.value.__cause__
+    )
+    # Batches produced before the injection still flowed through.
+    assert got >= 1
+
+
 def test_tokens_within_vocab(shard_dir):
     ds = _dataset(shard_dir)
     x, y = next(iter(create_dataloader(ds, batch_size=4)))

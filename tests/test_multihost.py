@@ -34,8 +34,19 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_worker_pair(phase: str, extra_env: dict | None = None) -> list[dict]:
-    """Launch 2 real worker processes for one phase; return per-rank JSON."""
+def _run_worker_pair(
+    phase: str,
+    extra_env: dict | None = None,
+    expect_rc: int = 0,
+    parse_json: bool = True,
+) -> list[dict]:
+    """Launch 2 real worker processes for one phase; return per-rank JSON.
+
+    ``expect_rc`` asserts BOTH processes exit with that code (the control-
+    plane phases exit 143/170/171 by contract). ``parse_json=False`` returns
+    ``{"rc", "stdout", "stderr"}`` per rank instead — for phases that exit
+    mid-run and never reach the JSON print.
+    """
     port = _free_port()
     env_base = {
         **os.environ,
@@ -66,9 +77,17 @@ def _run_worker_pair(phase: str, extra_env: dict | None = None) -> list[dict]:
                 f"multi-host worker ({phase}) timed out (rendezvous or "
                 f"collective deadlock?)"
             )
-        assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
-    return sorted(outs, key=lambda r: r["rank"])
+        assert p.returncode == expect_rc, (
+            f"worker ({phase}) rc={p.returncode}, expected {expect_rc}:\n"
+            f"stdout={out}\nstderr={err}"
+        )
+        if parse_json:
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        else:
+            outs.append({"rc": p.returncode, "stdout": out, "stderr": err})
+    if parse_json:
+        outs = sorted(outs, key=lambda r: r["rank"])
+    return outs  # launch order == rank order when not parsed
 
 
 @pytest.fixture(scope="module")
@@ -161,6 +180,152 @@ def test_tokens_per_second_is_global_not_per_host():
     assert r0["tokens_per_second"] == pytest.approx(
         r1["tokens_per_second"], rel=1e-2
     )
+
+
+# --- multi-host control plane (coordination.py) -----------------------------
+# Real 2-process proofs that a fault raised on ONE rank becomes the SAME
+# action on the SAME step on BOTH ranks. A rank acting alone would desync the
+# collective sequence and deadlock the pair — so mere completion inside the
+# harness timeout is itself part of the proof.
+
+
+def _train_argv(shard_dir: str, *extra: str) -> list[str]:
+    return [
+        "--data_dir", shard_dir,
+        "--mesh", "data=2,fsdp=4",
+        "--n_layer", "2", "--n_embd", "32", "--n_head", "2",
+        "--vocab_size", "257", "--seq_len", "32", "--batch", "1",
+        "--grad_accum_steps", "1", "--lr", "1e-3", "--workers", "1",
+        "--cli_every", "100",
+        *extra,
+    ]
+
+
+def test_consensus_spike_on_one_rank_rolls_back_both(shard_dir):
+    """Rank 1's spike monitor alone demands a rollback (monkeypatched inside
+    the worker); the consensus exchange must turn that into a pod-agreed
+    rollback executed by BOTH ranks at the same step boundary."""
+    r0, r1 = _run_worker_pair(
+        "consensus_spike",
+        {"TRAIN_ARGV": json.dumps(_train_argv(shard_dir, "--max_steps", "6"))},
+    )
+    # The rollback path ran exactly once on EACH rank (monitor.reset is its
+    # tell) even though only rank 1 requested it.
+    assert r0["resets"] == 1 and r1["resets"] == 1
+    # Rank 0 (primary) announced the pod-level decision at the agreed step.
+    assert r0["pod_agreed"]
+    # No checkpoint dir -> the rollback degrades to continue-in-place.
+    assert r0["continued_in_place"]
+    # And the pair still completed the full step budget afterwards.
+    assert r0["done"]
+
+
+@pytest.mark.slow  # ~2 process pairs x full CLI startup; mechanism variants below
+def test_consensus_preempt_on_rank0_saves_and_exits_143_everywhere(
+    shard_dir, tmp_path_factory
+):
+    """A preemption notice seen by rank 0's poller ONLY: the next exchange
+    raises the preempt bit pod-wide, both ranks run the emergency save (a
+    collective — it must line up) and exit rc 143 together."""
+    save_dir = str(tmp_path_factory.mktemp("mh_preempt"))
+    argv = _train_argv(
+        shard_dir, "--max_steps", "10",
+        "--save_dir", save_dir, "--save_every", "100",
+    )
+    r0, r1 = _run_worker_pair(
+        "train_cli",
+        {
+            "TRAIN_ARGV": json.dumps(argv),
+            "TRAIN_ARGV_RANK0": json.dumps(
+                ["--inject_preempt_notice_at", "2"]
+            ),
+        },
+        expect_rc=143,
+        parse_json=False,
+    )
+    assert "[preempt] emergency checkpoint at step 2" in r0["stdout"]
+    # The pod-wide emergency save committed (step dir + sentinel on disk).
+    step_dir = os.path.join(save_dir, "step_0000002")
+    assert os.path.isdir(step_dir), os.listdir(save_dir)
+    assert os.path.exists(os.path.join(step_dir, "COMMITTED"))
+    # Rank 1 never saw the notice locally — it acted on the agreed word.
+    assert "[inject] cloud preemption notice" not in r1["stdout"]
+
+
+@pytest.mark.slow
+def test_injected_desync_detected_within_one_interval(shard_dir):
+    """--inject_desync_at perturbs the LAST rank's params before step 2;
+    --desync_check_every 2 must catch it at the step-2 boundary, name rank 1,
+    and (with --max_rollbacks 0) abort the whole pod symmetrically."""
+    argv = _train_argv(
+        shard_dir, "--max_steps", "10",
+        "--desync_check_every", "2", "--inject_desync_at", "2",
+        "--max_rollbacks", "0",
+    )
+    r0, r1 = _run_worker_pair(
+        "train_cli",
+        {"TRAIN_ARGV": json.dumps(argv)},
+        expect_rc=1,  # SystemExit("error: loss diverged ...") on every rank
+        parse_json=False,
+    )
+    # Both ranks dispatched the (SPMD-symmetric) perturbation; only the last
+    # rank's traced factor differs from the identity.
+    assert "desync perturbation x1 on rank 0" in r0["stdout"]
+    assert "desync perturbation x1.001 on rank 1" in r1["stdout"]
+    # ...and the very next scheduled check caught it, blaming rank 1.
+    assert "[coord] DESYNC at step 2: rank(s) [1]" in r0["stdout"]
+    for r in (r0, r1):
+        assert "loss diverged" in r["stderr"]
+
+
+@pytest.mark.slow
+def test_worker_failure_on_rank0_aborts_pod_with_rc171(shard_dir):
+    """Rank 0's data worker dies mid-epoch; instead of rank 1 deadlocking in
+    the next collective, the exchange turns it into a coordinated abort:
+    BOTH ranks exit DATA_ABORT_EXIT_CODE at the same step."""
+    argv = _train_argv(
+        shard_dir, "--max_steps", "10", "--inject_worker_fail_at", "2",
+    )
+    r0, r1 = _run_worker_pair(
+        "train_cli",
+        {"TRAIN_ARGV": json.dumps(argv)},
+        expect_rc=171,
+        parse_json=False,
+    )
+    assert "[coord] local data worker failed" in r0["stdout"]
+    assert "injected data-worker failure" in r0["stdout"]
+    # Rank 1's worker was healthy: it aborted on the agreed word alone.
+    assert "[coord] local data worker failed" not in r1["stdout"]
+    for r in (r0, r1):
+        assert "pod-wide coordinated abort at step 2" in r["stdout"]
+
+
+@pytest.mark.slow
+def test_injected_hang_fires_watchdog_rc170_on_both_ranks(shard_dir):
+    """Rank 0 sleeps inside the step loop; its own watchdog fires from the
+    missing beat, rank 1's fires from the collective rank 0 never joins —
+    both exit HANG_EXIT_CODE within the timeout budget instead of hanging
+    forever."""
+    import time as _time
+
+    argv = _train_argv(
+        shard_dir, "--max_steps", "10",
+        "--hang_timeout_s", "3", "--inject_hang_at", "2",
+    )
+    t0 = _time.monotonic()
+    r0, r1 = _run_worker_pair(
+        "train_cli",
+        {"TRAIN_ARGV": json.dumps(argv)},
+        expect_rc=170,
+        parse_json=False,
+    )
+    elapsed = _time.monotonic() - t0
+    assert "[inject] simulated hang before step 2" in r0["stdout"]
+    for r in (r0, r1):
+        assert "[watchdog] no optimizer step completed in 3s" in r["stdout"]
+    # Bounded recovery: compile + 2 steps + the 3s timeout + teardown, with
+    # generous CI headroom — nowhere near the 90s injected sleep.
+    assert elapsed < 120, f"watchdog took {elapsed:.0f}s to unwedge the pair"
 
 
 def test_multiprocess_checkpoint_save_restore(tmp_path_factory):

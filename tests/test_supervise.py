@@ -116,6 +116,58 @@ def test_supervise_preemption_rc143_does_not_burn_attempts(tmp_path):
     assert "giving up" not in r.stderr
 
 
+def test_supervise_hang_rc170_restarts_but_burns_attempt(tmp_path):
+    # rc 170 is the hang-watchdog contract (coordination.HangWatchdog): a
+    # full-job restart is the recovery, but unlike rc 143 it IS a fault and
+    # must count against MAX_RESTARTS. Stub exits 170 once, then 0: with
+    # MAX_RESTARTS=1 the wrapper restarts once and the job completes.
+    marker = tmp_path / "hangs"
+    script = tmp_path / "fake_train.sh"
+    script.write_text(
+        "#!/usr/bin/env bash\n"
+        f'if [ ! -e "{marker}" ]; then\n'
+        f'  touch "{marker}"\n'
+        "  exit 170\n"
+        "fi\n"
+        "exit 0\n"
+    )
+    script.chmod(0o755)
+    r = subprocess.run(
+        ["bash", SUPERVISE, "bash", str(script)], env=_env("1"),
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "hang watchdog fired (rc=170)" in r.stderr
+    assert "restart 1/1" in r.stderr
+
+    # The attempt-burning proof: under MAX_RESTARTS=0 the same rc gives up
+    # immediately (a job that hangs every launch must not restart forever) —
+    # exactly where rc 143 would have restarted for free.
+    marker.unlink()
+    r = subprocess.run(
+        ["bash", SUPERVISE, "bash", str(script)], env=_env("0"),
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 170
+    assert "hang watchdog fired (rc=170)" in r.stderr
+    assert "giving up after 0 restarts" in r.stderr
+
+
+def test_supervise_data_abort_rc171_burns_attempt(tmp_path):
+    # rc 171 (pod-wide coordinated data-worker abort) follows the same
+    # burns-an-attempt policy as 170, with its own diagnostic line.
+    script = tmp_path / "fake_train.sh"
+    script.write_text("#!/usr/bin/env bash\nexit 171\n")
+    script.chmod(0o755)
+    r = subprocess.run(
+        ["bash", SUPERVISE, "bash", str(script)], env=_env("0"),
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 171
+    assert "data-worker abort (rc=171)" in r.stderr
+    assert "giving up after 0 restarts" in r.stderr
+
+
 def test_supervise_preempt_nan_grand_e2e(shard_dir, tmp_path):
     """The full resilience story through the wrapper: a NaN-poisoned step is
     skipped in place (guard), a SIGTERM preemption emergency-saves and exits
