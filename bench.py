@@ -135,6 +135,14 @@ def main() -> None:
         "microbench (scripts/bench_fused.py) confirms the win on-chip",
     )
     p.add_argument(
+        "--fused_matmul", default="off", choices=["off", "mlp", "proj", "all"],
+        help="fused matmul+epilogue Pallas kernels (ops/fused_matmul.py): "
+        "'mlp' = fc matmul+bias+GELU+dropout, 'proj' = attn/MLP projection "
+        "matmul+bias+residual+dropout, 'all' = both (qkv matmul+bias too). "
+        "Composable with --fused_layers; fused_matmul wins on shared legs. "
+        "Default off until scripts/bench_fused.py confirms the win on-chip",
+    )
+    p.add_argument(
         "--ckpt_every", type=int, default=0,
         help="save a real checkpoint every N measured steps (0 = off) and "
         "record the step-loop stall each save cost (ckpt_block_ms_*) — the "
@@ -170,6 +178,7 @@ def main() -> None:
                 ("--accum_dtype", args.accum_dtype != "auto"),
                 ("--loss_block_rows", args.loss_block_rows),
                 ("--fused_layers", args.fused_layers != "off"),
+                ("--fused_matmul", args.fused_matmul != "off"),
                 ("--ckpt_every", args.ckpt_every),
             ) if hit
         ]
@@ -280,6 +289,8 @@ def run_config_resilient(args, model: str, seq_len: int) -> dict:
         cmd += ["--scan_layers", args.scan_layers]
     if getattr(args, "fused_layers", "off") != "off":
         cmd += ["--fused_layers", args.fused_layers]
+    if getattr(args, "fused_matmul", "off") != "off":
+        cmd += ["--fused_matmul", args.fused_matmul]
     if getattr(args, "ckpt_every", 0):
         cmd += ["--ckpt_every", str(args.ckpt_every),
                 "--ckpt_async", getattr(args, "ckpt_async", "on")]
@@ -397,6 +408,8 @@ def run_config(args, model: str, seq_len: int) -> dict:
         config = config.replace(loss_block_rows=args.loss_block_rows)
     if getattr(args, "fused_layers", "off") != "off":
         config = config.replace(fused_layers=args.fused_layers)
+    if getattr(args, "fused_matmul", "off") != "off":
+        config = config.replace(fused_matmul=args.fused_matmul)
     if args.batch:
         micro_batch = args.batch
     elif not on_tpu:

@@ -77,6 +77,21 @@ class GPT2Config:
     # 128-aligned, sp/tp-sharded activations, decode's T=1 rows) fall back to
     # the unfused path automatically — same math, different dropout stream.
     fused_layers: str = "off"
+    # Fused matmul+epilogue Pallas kernels (ops/fused_matmul.py) — the v2
+    # step beyond fused_layers: the matmul itself runs in a tiled MXU kernel
+    # and the epilogue is applied to the fp32 accumulator tile before
+    # write-back. "mlp" fuses the MLP fc leg (matmul+bias+GELU+dropout);
+    # "proj" fuses the two proj legs (matmul+bias+residual+dropout, folding
+    # the residual add); "all" = both plus the qkv leg (plain matmul+bias;
+    # only when tensor parallelism is inactive — the tp path keeps the
+    # head-explicit einsum GSPMD shards). Composable with fused_layers: on a
+    # leg both cover, fused_matmul wins (it subsumes the v1 epilogue; the v1
+    # kernels keep the junctions fused_matmul doesn't reach, e.g. the
+    # attn->MLP LN). Default "off" until scripts/bench_fused.py proves the
+    # win on-chip. Unhostable shapes/meshes (K or M not 128-aligned — the
+    # 1.5B C=1600 — sp/tp-sharded activations, decode's T=1 rows) fall back
+    # to the unfused composition, recorded via the `fused_fallback` metric.
+    fused_matmul: str = "off"
     # Row-chunk size of the blocked CE ([rows, V] transient logits per
     # chunk). The default (ops/losses.py DEFAULT_BLOCK_ROWS — single source
     # of truth) is the measured v5e throughput optimum at 124M/345M
@@ -100,6 +115,11 @@ class GPT2Config:
             raise ValueError(
                 f"fused_layers={self.fused_layers!r}: expected "
                 "'off', 'ln', 'gelu' or 'all'"
+            )
+        if self.fused_matmul not in ("off", "mlp", "proj", "all"):
+            raise ValueError(
+                f"fused_matmul={self.fused_matmul!r}: expected "
+                "'off', 'mlp', 'proj' or 'all'"
             )
         if self.loss_impl not in ("blocked", "dense"):
             raise ValueError(
@@ -200,10 +220,19 @@ class CoordinationPolicy:
       exits ``resilience.HANG_EXIT_CODE`` for a supervised full-job restart
       (0 = watchdog disabled, the default: timeouts must be sized to the
       measured step time, which only the operator knows).
+    * ``consensus_every`` — run the pod-wide control-word exchange every K
+      optimizer steps instead of every step (1 = per-step, the default).
+      Fault flags (preempt, worker death, rollback demand, failed saves)
+      latch host-locally between exchanges and ride the next one; actions
+      only ever fire at exchange boundaries, so rollback/abort decisions
+      stay pod-consistent at any K. The trade is action latency: worst case
+      K-1 extra steps between a host noticing a fault and the pod acting on
+      it (see README multi-host section).
     """
 
     desync_check_every: int = 0
     hang_timeout_s: float = 0.0
+    consensus_every: int = 1
 
     def __post_init__(self) -> None:
         if self.desync_check_every < 0:
@@ -213,6 +242,10 @@ class CoordinationPolicy:
         if self.hang_timeout_s < 0:
             raise ValueError(
                 f"hang_timeout_s={self.hang_timeout_s} must be >= 0"
+            )
+        if self.consensus_every < 1:
+            raise ValueError(
+                f"consensus_every={self.consensus_every} must be >= 1"
             )
 
 

@@ -45,6 +45,13 @@ from gpt_2_distributed_tpu.ops.fused_layer import (
     fused_ln_residual_dropout,
     fused_residual_dropout,
 )
+from gpt_2_distributed_tpu.ops.fused_matmul import (
+    SALT_MM_ATTN_PROJ,
+    SALT_MM_MLP_PROJ,
+    matmul_bias,
+    matmul_bias_gelu_dropout,
+    matmul_bias_residual_dropout,
+)
 from gpt_2_distributed_tpu.ops.layers import dropout, layer_norm
 from gpt_2_distributed_tpu.ops.losses import blocked_cross_entropy
 
@@ -158,7 +165,13 @@ def qkv_proj(
         return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     w2 = bp["attn_qkv_w"].astype(cdt).reshape(c, 3 * c)
     b2 = bp["attn_qkv_b"].astype(cdt).reshape(3 * c)
-    qkv = y @ w2 + b2
+    if config.fused_matmul == "all":
+        # v2 tiled kernel with fp32 accumulation (ops/fused_matmul.py); the
+        # tp-active branch above stays head-explicit so GSPMD can shard H.
+        # Decode's T=1 rows fall back inside the op on real TPUs.
+        qkv = matmul_bias(y, w2, b2)
+    else:
+        qkv = y @ w2 + b2
     q, k, v = jnp.split(qkv, 3, axis=-1)
     return (
         q.reshape(b_, t_, h_, d_),
@@ -197,6 +210,12 @@ def _attn_sublayer(
         dropout_rate=config.attn_dropout, rng=r_attn, deterministic=deterministic,
     )
     o = o.reshape(b, t, c)
+    if _mm_proj_fused(config):
+        return matmul_bias_residual_dropout(
+            o, bp["attn_proj_w"].astype(cdt), bp["attn_proj_b"].astype(cdt), x,
+            rate=config.resid_dropout, rng=r_aresid, deterministic=deterministic,
+            salt=SALT_MM_ATTN_PROJ,
+        )
     o = o @ bp["attn_proj_w"].astype(cdt) + bp["attn_proj_b"].astype(cdt)
     o = dropout(o, config.resid_dropout, r_aresid, deterministic)
     return x + o
@@ -208,6 +227,14 @@ def _gelu_fused(config: GPT2Config) -> bool:
 
 def _ln_fused(config: GPT2Config) -> bool:
     return config.fused_layers in ("ln", "all")
+
+
+def _mm_fc_fused(config: GPT2Config) -> bool:
+    return config.fused_matmul in ("mlp", "all")
+
+
+def _mm_proj_fused(config: GPT2Config) -> bool:
+    return config.fused_matmul in ("proj", "all")
 
 
 def _mlp_core(
@@ -224,6 +251,13 @@ def _mlp_core(
     tensor is the largest between-matmul bandwidth pass in the block
     (ops/fused_layer.py); otherwise the unfused reference composition."""
     cdt = y.dtype
+    if _mm_fc_fused(config):
+        # v2: the fc matmul AND its epilogue in one kernel — supersedes the
+        # v1 epilogue-only fusion below when both flags cover this leg.
+        return matmul_bias_gelu_dropout(
+            y, bp["mlp_fc_w"].astype(cdt), bp["mlp_fc_b"].astype(cdt),
+            rate=config.resid_dropout, rng=rng, deterministic=deterministic,
+        )
     if _gelu_fused(config):
         h = y @ bp["mlp_fc_w"].astype(cdt)
         return fused_bias_gelu_dropout(
@@ -251,6 +285,12 @@ def _mlp_sublayer(
         r_mact = r_mresid = None
     y = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"], config.layer_norm_eps)
     y = _mlp_core(config, y, bp, r_mact, deterministic)
+    if _mm_proj_fused(config):
+        return matmul_bias_residual_dropout(
+            y, bp["mlp_proj_w"].astype(cdt), bp["mlp_proj_b"].astype(cdt), x,
+            rate=config.resid_dropout, rng=r_mresid, deterministic=deterministic,
+            salt=SALT_MM_MLP_PROJ,
+        )
     y = y @ bp["mlp_proj_w"].astype(cdt) + bp["mlp_proj_b"].astype(cdt)
     y = dropout(y, config.resid_dropout, r_mresid, deterministic)
     return x + y
@@ -285,6 +325,19 @@ def _attn_half_fused(
         dropout_rate=config.attn_dropout, rng=r_attn, deterministic=deterministic,
     )
     o = o.reshape(b, t, c)
+    if _mm_proj_fused(config):
+        # fused_matmul takes the proj leg: the v2 kernel already folds the
+        # dropout and residual add into the matmul write-back, leaving the
+        # v1 junction kernel nothing but the LN — run that unfused (a lone
+        # LN is a single bandwidth pass XLA handles fine).
+        r = matmul_bias_residual_dropout(
+            o, bp["attn_proj_w"].astype(cdt), bp["attn_proj_b"].astype(cdt), x,
+            rate=config.resid_dropout, rng=r_aresid, deterministic=deterministic,
+            salt=SALT_MM_ATTN_PROJ,
+        )
+        return r, layer_norm(
+            r, bp["ln2_scale"], bp["ln2_bias"], config.layer_norm_eps
+        )
     o = o @ bp["attn_proj_w"].astype(cdt) + bp["attn_proj_b"].astype(cdt)
     return fused_ln_residual_dropout(
         x, o, bp["ln2_scale"], bp["ln2_bias"],
@@ -310,6 +363,15 @@ def _mlp_half_fused(
     else:
         r_mact = r_mresid = None
     y = _mlp_core(config, y2, bp, r_mact, deterministic)
+    if _mm_proj_fused(config):
+        # fused_matmul takes the proj leg (matmul + bias + dropout +
+        # block-closing residual in one kernel) — subsumes the v1
+        # residual+dropout kernel below.
+        return matmul_bias_residual_dropout(
+            y, bp["mlp_proj_w"].astype(cdt), bp["mlp_proj_b"].astype(cdt), x,
+            rate=config.resid_dropout, rng=r_mresid, deterministic=deterministic,
+            salt=SALT_MM_MLP_PROJ,
+        )
     y = y @ bp["mlp_proj_w"].astype(cdt) + bp["mlp_proj_b"].astype(cdt)
     return fused_residual_dropout(
         x, y, rate=config.resid_dropout, rng=r_mresid, deterministic=deterministic,

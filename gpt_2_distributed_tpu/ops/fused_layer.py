@@ -64,6 +64,7 @@ from gpt_2_distributed_tpu.ops.spmd import (
     HEAD_AXIS_NAMES,
     dividing_axes,
     dropout_hash_bits,
+    record_fused_fallback,
 )
 
 # jax 0.4.37 names this TPUCompilerParams; newer releases renamed it. Resolve
@@ -636,12 +637,14 @@ def fused_ln_residual_dropout(
     n = x.size // c
     mesh, b_axes = _mesh_axes(x.shape[0])
     if b_axes is None:
+        record_fused_fallback("ln_residual_dropout", "sp/tensor-sharded mesh")
         return _reference_ln_residual_dropout(x, o, scale, bias, eps, rate_eff, rng)
     shards = 1
     for a in b_axes:
         shards *= mesh.shape[a]
     bn = _pick_block_rows(n // shards, c, interpret)
     if bn is None:
+        record_fused_fallback("ln_residual_dropout", "shape won't tile")
         return _reference_ln_residual_dropout(x, o, scale, bias, eps, rate_eff, rng)
     fn = _build_ln_res_drop(rate_eff, float(eps), bn, c, salt, interpret)
 
@@ -684,12 +687,14 @@ def fused_residual_dropout(
     n = x.size // c
     mesh, b_axes = _mesh_axes(x.shape[0])
     if b_axes is None:
+        record_fused_fallback("residual_dropout", "sp/tensor-sharded mesh")
         return x + unfused_dropout(o, rate_eff, rng, deterministic=False)
     shards = 1
     for a in b_axes:
         shards *= mesh.shape[a]
     bn = _pick_block_rows(n // shards, c, interpret)
     if bn is None:
+        record_fused_fallback("residual_dropout", "shape won't tile")
         return x + unfused_dropout(o, rate_eff, rng, deterministic=False)
     fn = _build_res_drop(rate_eff, bn, c, salt, interpret)
 
@@ -736,12 +741,14 @@ def fused_bias_gelu_dropout(
     n = h.size // f
     mesh, b_axes = _mesh_axes(h.shape[0])
     if b_axes is None:
+        record_fused_fallback("bias_gelu_dropout", "sp/tensor-sharded mesh")
         return _reference_bias_gelu_dropout(h, b, rate_eff, rng)
     shards = 1
     for a in b_axes:
         shards *= mesh.shape[a]
     bn = _pick_block_rows(n // shards, f, interpret)
     if bn is None:
+        record_fused_fallback("bias_gelu_dropout", "shape won't tile")
         return _reference_bias_gelu_dropout(h, b, rate_eff, rng)
     fn = _build_bias_gelu_drop(rate_eff, bn, f, salt, interpret)
 

@@ -34,6 +34,48 @@ def dividing_axes(mesh: Mesh, names: tuple[str, ...], dim: int) -> tuple[str, ..
     return tuple(axes)
 
 
+# --- fused-path fallback visibility -----------------------------------------
+#
+# The fused kernels (ops/fused_layer.py, ops/fused_matmul.py) silently degrade
+# to their unfused XLA compositions when the active mesh shards an axis they
+# can't honor (sp / tensor-parallel) or the shape won't tile (e.g. the 1.5B
+# C=1600 preset, decode's T=1 rows). Degraded-not-wrong — but a user
+# benchmarking `--fused_matmul all` on such a config would measure nothing.
+# Every fallback site records itself here: first occurrence per (site, reason)
+# warns once on stderr-visible stdout, and train.py surfaces the running count
+# as the `fused_fallback` metric. Counts tick at TRACE time (once per compiled
+# shape, not per step) — a nonzero value means "some requested fused path is
+# not actually fused", which is the signal that matters.
+
+_FUSED_FALLBACKS: dict[tuple[str, str], int] = {}
+
+
+def record_fused_fallback(site: str, reason: str) -> None:
+    """Note that the fused op at ``site`` degraded to its unfused path."""
+    from gpt_2_distributed_tpu.utils.operating_point import warn_once
+
+    _FUSED_FALLBACKS[(site, reason)] = _FUSED_FALLBACKS.get((site, reason), 0) + 1
+    warn_once(
+        f"fused_fallback:{site}:{reason}",
+        f"fused op '{site}' fell back to the unfused path ({reason}); "
+        "the requested fusion is not running for this shape/mesh",
+    )
+
+
+def fused_fallback_count() -> int:
+    """Total recorded fallbacks (all sites) since process start / last reset."""
+    return sum(_FUSED_FALLBACKS.values())
+
+
+def fused_fallback_events() -> dict[tuple[str, str], int]:
+    """Per-(site, reason) fallback counts — for tests and diagnostics."""
+    return dict(_FUSED_FALLBACKS)
+
+
+def reset_fused_fallbacks() -> None:
+    _FUSED_FALLBACKS.clear()
+
+
 def dropout_hash_bits(seed, b, h, row, col):
     """uint32 random bits from a murmur3-finalizer hash of absolute
     (batch, head, row, col) coordinates mixed with ``seed``.

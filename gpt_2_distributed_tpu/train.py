@@ -251,6 +251,17 @@ def build_parser() -> argparse.ArgumentParser:
         "to the last verified checkpoint. 0 = off. Identity single-process.",
     )
     p.add_argument(
+        "--consensus_every", type=int, default=1,
+        help="multi-host control plane: run the pod-wide control-word "
+        "allgather every K optimizer steps instead of every step (default "
+        "1). Fault flags (preempt, worker death, rollback demand, failed "
+        "saves) latch host-locally between exchanges and ride the next one; "
+        "actions fire only at exchange boundaries, so decisions stay "
+        "pod-consistent at any K at the cost of up to K-1 steps of extra "
+        "action latency (README multi-host section). Identity "
+        "single-process.",
+    )
+    p.add_argument(
         "--hang_timeout_s", type=float, default=0.0,
         help="hang watchdog (coordination.py): if no optimizer step "
         "completes within this many seconds, dump all-thread stacks, "
@@ -323,6 +334,18 @@ def build_parser() -> argparse.ArgumentParser:
         "'off' until the marginal microbench (scripts/bench_fused.py) "
         "confirms the win on-chip; unsupported shapes/meshes fall back to "
         "the unfused path automatically",
+    )
+    p.add_argument(
+        "--fused_matmul", default="off", choices=["off", "mlp", "proj", "all"],
+        help="fused matmul+epilogue Pallas kernels (ops/fused_matmul.py, "
+        "v2): the matmul runs in a tiled MXU kernel with the epilogue "
+        "applied to the fp32 accumulator tile before write-back. 'mlp' "
+        "fuses the fc leg (matmul+bias+GELU+dropout), 'proj' the two proj "
+        "legs (matmul+bias+residual+dropout), 'all' both plus the qkv leg. "
+        "Composable with --fused_layers (fused_matmul wins on shared legs). "
+        "Default 'off' until scripts/bench_fused.py confirms the win "
+        "on-chip; unsupported shapes/meshes fall back to the unfused path, "
+        "counted in the fused_fallback metric",
     )
     p.add_argument(
         "--loss_block_rows", type=int, default=0,
@@ -436,6 +459,7 @@ def main(argv: list[str] | None = None) -> None:
         coord_policy = CoordinationPolicy(
             desync_check_every=args.desync_check_every,
             hang_timeout_s=args.hang_timeout_s,
+            consensus_every=args.consensus_every,
         )
     except ValueError as e:
         build_parser().error(str(e))
@@ -485,6 +509,7 @@ def main(argv: list[str] | None = None) -> None:
     )
     from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
     from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.ops.spmd import fused_fallback_count
     from gpt_2_distributed_tpu.parallel.sharding import (
         shard_batch,
         shard_params_and_opt_state,
@@ -516,6 +541,8 @@ def main(argv: list[str] | None = None) -> None:
         config = config.replace(loss_block_rows=args.loss_block_rows)
     if args.fused_layers != "off":
         config = config.replace(fused_layers=args.fused_layers)
+    if args.fused_matmul != "off":
+        config = config.replace(fused_matmul=args.fused_matmul)
 
     # --- mesh ---------------------------------------------------------------
     try:
@@ -796,6 +823,15 @@ def main(argv: list[str] | None = None) -> None:
         multihost = bus.process_count > 1
         desync_count = 0
         skip_observed_last = False
+        # --consensus_every K: the control-word exchange runs only at step
+        # boundaries where global_step % K == 0 (plus the first iteration of
+        # every epoch, so a worker death before any step of an epoch still
+        # reaches an exchange). Fault flags latch host-locally in between —
+        # preempt/worker_error/rollback_requested are already persistent;
+        # skip_observed_last becomes a latch below — and actions fire only at
+        # exchange boundaries, keeping decisions pod-consistent at any K with
+        # up to K-1 steps of extra action latency.
+        consensus_k = coord_policy.consensus_every
 
         watchdog = None
         if coord_policy.hang_timeout_s > 0:
@@ -848,8 +884,11 @@ def main(argv: list[str] | None = None) -> None:
                 reason = int(p_m.skip_reason)
                 # Fed to the next consensus exchange: the guard's decision is
                 # computed from globally-reduced values, so hosts disagreeing
-                # on it is itself a desync signal (warned on below).
-                skip_observed_last = bool(reason)
+                # on it is itself a desync signal (warned on below). Latched
+                # (OR) rather than overwritten: with --consensus_every > 1
+                # several flushes can pass between exchanges, and a skip in
+                # any of them must ride the next exchange.
+                skip_observed_last = skip_observed_last or bool(reason)
                 if reason:
                     last_skip_reason_host = reason
                     if is_primary():
@@ -897,6 +936,12 @@ def main(argv: list[str] | None = None) -> None:
                 extra["desync_detected"] = desync_count
             if dataset.read_retry_count:
                 extra["data_read_retries"] = dataset.read_retry_count
+            if fused_fallback_count():
+                # Nonzero only when a requested --fused_layers/--fused_matmul
+                # path degraded to unfused ops (trace-time count — once per
+                # compiled shape, not per step). The warn-once fires at the
+                # fallback site; this keeps the signal on the metrics record.
+                extra["fused_fallback"] = fused_fallback_count()
             # p_step is the post-increment global step; optax evaluated the
             # schedule at count p_step - 1 for that update, so log that one.
             # A skipped step's loss/grad_norm are the REJECTED values (the
@@ -1016,8 +1061,10 @@ def main(argv: list[str] | None = None) -> None:
                 )
 
                 micro: list[tuple[np.ndarray, np.ndarray]] = []
+                last_micro: list[tuple[np.ndarray, np.ndarray]] = []
                 loader_iter = iter(loader)
                 worker_error: BaseException | None = None
+                first_inner_iter = True
                 while step_in_epoch < epoch_opt_steps:
                     # (1) Host-local fetch of one optimizer step's
                     # micro-batches. Deliberately NOT a collective: a host
@@ -1046,6 +1093,23 @@ def main(argv: list[str] | None = None) -> None:
                                 f"requesting pod-wide abort",
                                 flush=True,
                             )
+                    if (
+                        multihost
+                        and worker_error is not None
+                        and len(micro) < args.grad_accum_steps
+                        and last_micro
+                    ):
+                        # --consensus_every > 1 and the worker died between
+                        # exchange boundaries: the pod can only act at the
+                        # next boundary, and every host must keep dispatching
+                        # symmetric train steps until then. Replay the last
+                        # full micro-batch set (params stay pod-identical —
+                        # gradients still psum) for the <= K-1 steps before
+                        # the agreed abort.
+                        micro = [
+                            last_micro[i % len(last_micro)]
+                            for i in range(args.grad_accum_steps)
+                        ]
 
                     # (2) Desync detector: symmetric by construction (every
                     # host agrees on global_step), so the allgather inside
@@ -1078,8 +1142,16 @@ def main(argv: list[str] | None = None) -> None:
 
                     # (3) Consensus exchange: OR-reduce the per-host control
                     # words and act on the AGREED word — the only place fault
-                    # flags turn into actions on a pod.
-                    if multihost:
+                    # flags turn into actions on a pod. With --consensus_every
+                    # K > 1 it runs only at K-step boundaries (plus each
+                    # epoch's first iteration — symmetric: hosts enter epochs
+                    # in lockstep); flags latch in between.
+                    exchange_now = multihost and (
+                        first_inner_iter
+                        or global_step % consensus_k == 0
+                    )
+                    first_inner_iter = False
+                    if exchange_now:
                         agreed = decode_control_word(bus.exchange(
                             encode_control_word(
                                 preempt=preempt.preempted(),
@@ -1102,6 +1174,9 @@ def main(argv: list[str] | None = None) -> None:
                                 f"guard inputs may have diverged",
                                 flush=True,
                             )
+                        # The exchange consumed the latched skip flag; re-arm
+                        # the latch for the next interval.
+                        skip_observed_last = False
                         if agreed.rollback:
                             rollback_requested = True
                             if is_primary():
@@ -1124,6 +1199,15 @@ def main(argv: list[str] | None = None) -> None:
                                 or (
                                     args.save_every
                                     and global_step % args.save_every == 0
+                                )
+                                # K>1 boundaries can straddle the % cadence;
+                                # save whenever a full interval has elapsed
+                                # (no-op at K=1 — kept bit-identical).
+                                or (
+                                    consensus_k > 1
+                                    and args.save_every
+                                    and global_step - last_saved_step
+                                    >= args.save_every
                                 )
                             )
                         ):
@@ -1177,6 +1261,7 @@ def main(argv: list[str] | None = None) -> None:
 
                     x = np.stack([m[0] for m in micro])
                     y = np.stack([m[1] for m in micro])
+                    last_micro = micro  # replay source if a worker dies mid-interval
                     micro = []
                     x, y = shard_batch((x, y), mesh)
                     if use_guard:

@@ -22,6 +22,14 @@ is converted to effective GB/s under the op's minimal-traffic model
 (LN+resid reads x,o and writes r,y -> 4·N·C·itemsize; resid reads x,o writes
 r -> 3·; bias+GELU reads h writes out -> 2·, bias negligible).
 
+The matmul+epilogue kernels (``ops/fused_matmul.py``) are timed the same
+way: qkv (x[N,C]@[C,3C]+b), fc (matmul+bias+GELU+dropout, [C,4C]) and proj
+(matmul+bias+residual+dropout, [C,C]). Their minimal traffic is
+(N·K + K·M + N·M)·itemsize, plus N·M·itemsize for the proj op's residual
+read and N·M·4 for the fc op's fp32 pre-activation stash; matmul legs
+additionally report TF/s (2·N·K·M flops over the fwd marginal), the number
+that says whether the fused kernel keeps the MXU fed.
+
 On CPU this runs the kernels in ``interpret=True`` mode — the numbers there
 say nothing about TPU bandwidth (interpret mode is a Python-level emulation,
 orders of magnitude slower than the XLA unfused path) but prove the
@@ -71,10 +79,16 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from gpt_2_distributed_tpu.ops.activations import gelu_tanh
     from gpt_2_distributed_tpu.ops.fused_layer import (
         fused_bias_gelu_dropout,
         fused_ln_residual_dropout,
         fused_residual_dropout,
+    )
+    from gpt_2_distributed_tpu.ops.fused_matmul import (
+        matmul_bias,
+        matmul_bias_gelu_dropout,
+        matmul_bias_residual_dropout,
     )
     from gpt_2_distributed_tpu.ops.layers import dropout, layer_norm
 
@@ -161,6 +175,43 @@ def main() -> None:
         g = 0.5 * u32 * (1.0 + jnp.tanh(c0 * (u32 + a * u32**3)))
         return dropout(g.astype(h.dtype), rate, key, deterministic=False)
 
+    # Matmul+epilogue operands. Widths follow the model legs at feature
+    # width C (qkv C->3C, fc C->4C, proj C->C); all are multiples of 128 at
+    # the defaults so the tiled kernels engage rather than falling back.
+    # Each chained fn maps [N,C] -> [N,C] (wide outputs sliced back to C) so
+    # the feedback loop stays data-dependent at a fixed shape.
+    w_qkv, b_qkv = arr(C, 3 * C), arr(3 * C)
+    w_fc, b_fc = arr(C, F), arr(F)
+    w_pr, b_pr = arr(C, C), arr(C)
+    r0 = arr(rows, C)
+
+    def fused_mm_qkv(x):
+        return matmul_bias(x, w_qkv, b_qkv)[:, :C]
+
+    def unfused_mm_qkv(x):
+        return (x @ w_qkv + b_qkv)[:, :C]
+
+    def fused_mm_fc(x):
+        return matmul_bias_gelu_dropout(
+            x, w_fc, b_fc, rate=rate, rng=key, deterministic=False,
+        )[:, :C]
+
+    def unfused_mm_fc(x):
+        return dropout(
+            gelu_tanh(x @ w_fc + b_fc), rate, key, deterministic=False,
+        )[:, :C]
+
+    def fused_mm_proj(x):
+        return matmul_bias_residual_dropout(
+            x, w_pr, b_pr, r0, rate=rate, rng=key, deterministic=False,
+        )
+
+    def unfused_mm_proj(x):
+        return r0 + dropout(x @ w_pr + b_pr, rate, key, deterministic=False)
+
+    def mm_traffic(k, m, extra=0):
+        return (rows * k + k * m + rows * m + extra) * itemsize
+
     two = jnp.asarray(2.0, dtype)
     ops = {
         # y feeds x, o stays fixed: chainable and data-dependent.
@@ -183,6 +234,30 @@ def main() -> None:
             # output to keep the chained values in the active region.
             operands=(arr(rows, F),),
             chain=lambda fn: (lambda h: fn(h) * two),
+        ),
+        "matmul_bias_qkv": dict(
+            traffic=mm_traffic(C, 3 * C),
+            flops=2 * rows * C * (3 * C),
+            fused=fused_mm_qkv, unfused=unfused_mm_qkv,
+            operands=(arr(rows, C),),
+            chain=lambda fn: (lambda x: fn(x) * two),
+        ),
+        "matmul_bias_gelu_dropout_fc": dict(
+            # + rows*F*4: the fused forward stashes the fp32 pre-activation
+            # for the backward's in-kernel GELU-derivative recompute.
+            traffic=mm_traffic(C, F, extra=0) + rows * F * 4,
+            flops=2 * rows * C * F,
+            fused=fused_mm_fc, unfused=unfused_mm_fc,
+            operands=(arr(rows, C),),
+            chain=lambda fn: (lambda x: fn(x) * two),
+        ),
+        "matmul_bias_residual_dropout_proj": dict(
+            # + rows*C: the residual-stream read.
+            traffic=mm_traffic(C, C, extra=rows * C),
+            flops=2 * rows * C * C,
+            fused=fused_mm_proj, unfused=unfused_mm_proj,
+            operands=(arr(rows, C),),
+            chain=lambda fn: (lambda x: fn(x) * two),
         ),
     }
 
@@ -243,6 +318,10 @@ def main() -> None:
                             if leg == "fwd" else None
                         ),
                     }
+                    if "flops" in spec and leg == "fwd":
+                        entry[f"{variant}_{leg}"]["tf_per_s"] = round(
+                            spec["flops"] / dt / 1e12, 3
+                        )
         f_us = entry["fused_fwd"]["us"]
         u_us = entry["unfused_fwd"]["us"]
         entry["fwd_speedup"] = (

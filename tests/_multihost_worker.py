@@ -126,6 +126,53 @@ def main() -> None:
         jax.distributed.shutdown()
         return
 
+    if phase == "consensus_every":
+        # --consensus_every 4: rank 1's rollback demand latches host-locally
+        # mid-interval and must NOT act until the next K-step exchange
+        # boundary — where BOTH ranks take the identical deferred action.
+        import io
+        from contextlib import redirect_stdout
+
+        from gpt_2_distributed_tpu import resilience, train
+
+        calls = {"observe": 0, "reset": 0}
+        orig_observe = resilience.SpikeMonitor.observe
+        orig_reset = resilience.SpikeMonitor.reset
+
+        def fake_observe(self, loss, skipped=False):
+            calls["observe"] += 1
+            if rank == 1 and calls["observe"] == 2:
+                # Step 2's flush runs after step 3's dispatch: under K=1
+                # this would act at the global_step=3 exchange ("before
+                # step 4"); K=4 must defer it to the boundary at 4.
+                return "rollback"
+            return orig_observe(self, loss, skipped=skipped)
+
+        def counting_reset(self):
+            if hasattr(self, "n_healthy"):
+                calls["reset"] += 1
+            return orig_reset(self)
+
+        resilience.SpikeMonitor.observe = fake_observe
+        resilience.SpikeMonitor.reset = counting_reset
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            train.main(json.loads(os.environ["TRAIN_ARGV"]))
+        out = buf.getvalue()
+        record = {
+            "rank": rank,
+            "observe_calls": calls["observe"],
+            "resets": calls["reset"],
+            "acted_at_boundary": "[coord] pod-agreed rollback before step 5" in out,
+            "acted_early": "[coord] pod-agreed rollback before step 4" in out,
+            "continued_in_place": "continuing in place" in out,
+            "done": "training done: 6 optimizer steps" in out,
+        }
+        print(json.dumps(record))
+        sys.stdout.flush()
+        jax.distributed.shutdown()
+        return
+
     if phase == "train_cli":
         # Generic CLI phase: argv from the environment (plus rank-conditional
         # extras), exits propagated verbatim — the parent asserts the process

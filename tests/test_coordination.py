@@ -252,6 +252,10 @@ def test_coordination_policy_validation():
         CoordinationPolicy(desync_check_every=-1)
     with pytest.raises(ValueError):
         CoordinationPolicy(hang_timeout_s=-0.5)
+    # consensus_every amortizes the exchange; 0 would mean "never agree".
+    CoordinationPolicy(consensus_every=4)
+    with pytest.raises(ValueError, match="consensus_every"):
+        CoordinationPolicy(consensus_every=0)
 
 
 def test_exit_codes_are_distinct():

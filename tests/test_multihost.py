@@ -220,6 +220,29 @@ def test_consensus_spike_on_one_rank_rolls_back_both(shard_dir):
     assert r0["done"]
 
 
+def test_consensus_every_defers_action_to_exchange_boundary(shard_dir):
+    """--consensus_every 4: a rollback demanded mid-interval (rank 1, step
+    2's flush — which lands after step 3's dispatch) must latch host-locally
+    and fire only at the global_step=4 boundary exchange. Under the default
+    K=1 the same injection acts one step earlier ("before step 4" — what
+    test_consensus_spike's timing pins); both ranks must take the deferred
+    action together and still finish the full step budget."""
+    r0, r1 = _run_worker_pair(
+        "consensus_every",
+        {"TRAIN_ARGV": json.dumps(_train_argv(
+            shard_dir, "--max_steps", "6", "--consensus_every", "4",
+        ))},
+    )
+    # Deferred, not dropped — and not acted on early (primary announces).
+    assert r0["acted_at_boundary"] and not r0["acted_early"]
+    # The rollback ran exactly once on EACH rank, pod-agreed.
+    assert r0["resets"] == 1 and r1["resets"] == 1
+    # No checkpoint dir -> degrade to continue-in-place, full budget done
+    # (both prints are primary-only).
+    assert r0["continued_in_place"]
+    assert r0["done"]
+
+
 @pytest.mark.slow  # ~2 process pairs x full CLI startup; mechanism variants below
 def test_consensus_preempt_on_rank0_saves_and_exits_143_everywhere(
     shard_dir, tmp_path_factory
