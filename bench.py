@@ -143,6 +143,16 @@ def main() -> None:
         "Default off until scripts/bench_fused.py confirms the win on-chip",
     )
     p.add_argument(
+        "--shard_update", default="off", choices=["off", "on", "auto"],
+        help="ZeRO-2-style cross-replica sharded weight update (train.py's "
+        "--shard_update): reduce-scatter grads over 'data', shard the AdamW "
+        "moments and update ~1/data per chip, all-gather fresh params. "
+        "Default off so headline records stay comparable round-over-round; "
+        "the record always carries shard_update/opt_state_bytes_per_device/"
+        "update_ms, so a DP vs sharded-update vs FSDP comparison is one "
+        "flag flip on the same config",
+    )
+    p.add_argument(
         "--ckpt_every", type=int, default=0,
         help="save a real checkpoint every N measured steps (0 = off) and "
         "record the step-loop stall each save cost (ckpt_block_ms_*) — the "
@@ -179,6 +189,7 @@ def main() -> None:
                 ("--loss_block_rows", args.loss_block_rows),
                 ("--fused_layers", args.fused_layers != "off"),
                 ("--fused_matmul", args.fused_matmul != "off"),
+                ("--shard_update", args.shard_update != "off"),
                 ("--ckpt_every", args.ckpt_every),
             ) if hit
         ]
@@ -291,6 +302,8 @@ def run_config_resilient(args, model: str, seq_len: int) -> dict:
         cmd += ["--fused_layers", args.fused_layers]
     if getattr(args, "fused_matmul", "off") != "off":
         cmd += ["--fused_matmul", args.fused_matmul]
+    if getattr(args, "shard_update", "off") != "off":
+        cmd += ["--shard_update", args.shard_update]
     if getattr(args, "ckpt_every", 0):
         cmd += ["--ckpt_every", str(args.ckpt_every),
                 "--ckpt_async", getattr(args, "ckpt_async", "on")]
@@ -348,10 +361,13 @@ def run_config(args, model: str, seq_len: int) -> dict:
     from gpt_2_distributed_tpu.models import gpt2
     from gpt_2_distributed_tpu.parallel.mesh import MeshSpec, activate_mesh, create_mesh
     from gpt_2_distributed_tpu.parallel.sharding import (
+        resolve_shard_update,
         shard_batch,
         shard_params_and_opt_state,
+        sharded_update_spec,
     )
     from gpt_2_distributed_tpu.parallel.train_step import (
+        make_accum_step,
         make_optimizer,
         make_train_step,
     )
@@ -478,15 +494,36 @@ def run_config(args, model: str, seq_len: int) -> dict:
     x = rng_np.integers(0, config.vocab_size, shape, dtype=np.int32)
     y = rng_np.integers(0, config.vocab_size, shape, dtype=np.int32)
 
+    use_shard_update = resolve_shard_update(
+        getattr(args, "shard_update", "off"), mesh
+    )
     with activate_mesh(mesh):
-        params, opt_state, _, _ = shard_params_and_opt_state(params, optimizer, mesh)
+        params, opt_state, _, _ = shard_params_and_opt_state(
+            params, optimizer, mesh, shard_update=use_shard_update
+        )
         accum_bf16 = args.accum_dtype == "bf16" or (
             args.accum_dtype == "auto" and single_chip_774m
         )
+        accum_dtype = jnp.bfloat16 if accum_bf16 else None
         step = make_train_step(
             config, optimizer, unroll_accum=args.unroll_accum,
-            accum_dtype=jnp.bfloat16 if accum_bf16 else None,
+            accum_dtype=accum_dtype,
+            sharded_update=(
+                sharded_update_spec(params, optimizer, mesh)
+                if use_shard_update else None
+            ),
         )
+        # Per-device optimizer-state footprint at THIS operating point: the
+        # number --shard_update exists to shrink (~1/data in dp mode).
+        # Replicated leaves count their full size per device — that is the
+        # per-device truth, not double counting.
+        n_local = max(1, len(jax.local_devices()))
+        opt_state_bytes_per_device = sum(
+            sum(s.data.nbytes for s in leaf.addressable_shards)
+            if hasattr(leaf, "addressable_shards")
+            else leaf.nbytes * n_local
+            for leaf in jax.tree_util.tree_leaves(opt_state)
+        ) // n_local
         x, y = shard_batch((x, y), mesh)
         key = jax.random.PRNGKey(0)
 
@@ -556,6 +593,28 @@ def run_config(args, model: str, seq_len: int) -> dict:
         # TPU tunnels.
         final_loss = float(metrics.loss)
         dt = time.perf_counter() - t0
+
+        # Update-phase attribution by step-delta: time the SAME accumulation
+        # (forward+backward+scan+grad-norm, no donation needed — it never
+        # writes state) and subtract. What remains is the optimizer update
+        # plus, under --shard_update, its reduce-scatter/all-gather comms —
+        # the replicated-vs-sharded update comparison in one field, with no
+        # device trace required.
+        accum_step = make_accum_step(
+            config, unroll_accum=args.unroll_accum, accum_dtype=accum_dtype
+        )
+        accum_loss, _ = accum_step(params, x, y, key, 0)
+        float(accum_loss)  # compile + sync
+        accum_reps = max(2, min(steps, 8))
+        t_acc = time.perf_counter()
+        for i in range(accum_reps):
+            accum_loss, _ = accum_step(params, x, y, key, i)
+        # One final read suffices: the device stream executes the queued
+        # programs in order, so the last result completing bounds them all.
+        float(accum_loss)
+        accum_ms = (time.perf_counter() - t_acc) / accum_reps * 1e3
+        update_ms = max(0.0, dt / steps * 1e3 - accum_ms)
+
         ckpt_drain_ms = None
         if saver is not None:
             # Background commits still running after the loop are real work
@@ -605,6 +664,9 @@ def run_config(args, model: str, seq_len: int) -> dict:
         "grad_accum": grad_accum,
         "accum_dtype": "bf16" if accum_bf16 else "fp32",
         "n_chips": n_chips,
+        "shard_update": use_shard_update,
+        "opt_state_bytes_per_device": int(opt_state_bytes_per_device),
+        "update_ms": round(update_ms, 2),
         "device": jax.devices()[0].device_kind,
         "flops_per_token": flops_per_token(config, seq_len),
         "step_time_ms": round(dt / steps * 1000, 2),

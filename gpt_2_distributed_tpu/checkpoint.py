@@ -354,7 +354,14 @@ def _restore_with_layout_migration(
     the template's but has the same element count and dtype (lossless
     reshape). Exists for stored-layout evolutions — e.g. the fused qkv
     moving from [L, C, 3C] to head-explicit [L, C, 3, H, D] (bit-identical
-    data, different factoring) — so pre-change checkpoints stay loadable."""
+    data, different factoring) — so pre-change checkpoints stay loadable.
+
+    SHARDING-layout changes need no migration branch at all: global shapes
+    are unchanged and the sharding-annotated abstract targets re-place each
+    leaf as orbax reads it — this is what lets a checkpoint saved with a
+    replicated optimizer state restore into ``--shard_update``'s
+    data-sharded layout and vice versa, losslessly (pinned by the
+    cross-layout tests in tests/test_shard_update.py)."""
     unplaced = False
     try:
         restored = ckptr.restore(item_path, _as_abstract(template, shardings))

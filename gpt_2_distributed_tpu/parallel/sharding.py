@@ -13,11 +13,18 @@ layer axis (axis 0 of block leaves) — the lax.scan over layers slices that
 axis every iteration, and sharding it would turn each slice into a collective.
 Leaves with no divisible dim stay replicated (e.g. nothing forces vocab 50257
 to pad).
+
+Update rule (``--shard_update``, ZeRO-2-style): pure-DP meshes replicate the
+AdamW update N times; :func:`update_pspecs` layers the 'data' axis onto each
+leaf's param spec by the same divisibility rule, so the accumulated gradient
+reduce-scatters, each replica updates a 1/N param shard with 1/N of the
+optimizer state, and the fresh params all-gather — same comms volume as the
+grad all-reduce (RS + AG = AR), 1/N the update flops and moment memory.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import numpy as np
@@ -121,6 +128,82 @@ def param_pspecs(params: Any, mesh: Mesh) -> Any:
     return specs
 
 
+def _leaf_update_pspec(
+    path: tuple, leaf: Any, data_size: int, fsdp_size: int, tp_size: int = 1
+) -> P:
+    """Update-phase PartitionSpec for one leaf under ``--shard_update``.
+
+    Starts from the steady-state param spec (fsdp/tp placements) and layers
+    the 'data' axis onto the best remaining dim, by the same rule fsdp uses:
+    largest divisible dim wins, trailing dims break ties, the stacked layer
+    axis (axis 0 of block leaves) is never taken. A leaf with no free
+    divisible dim keeps its param spec — i.e. its gradient/moments stay
+    replicated across 'data' and every replica redundantly updates it (the
+    divisibility fallback, mirroring the fsdp rule; at GPT-2 shapes only
+    scalars and odd-width LN/bias leaves of non-128-multiple widths hit it).
+    """
+    spec = _leaf_pspec(path, leaf, fsdp_size, tp_size)
+    shape = np.shape(leaf)
+    if data_size <= 1 or len(shape) == 0:
+        return spec
+    is_block = any(getattr(k, "key", None) == "block" for k in path)
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    best_dim = None
+    for d in range(len(shape) - 1, 0 if is_block else -1, -1):
+        if entries[d] is None and shape[d] % data_size == 0:
+            if best_dim is None or shape[d] > shape[best_dim]:
+                best_dim = d
+    if best_dim is None:
+        return spec
+    entries[best_dim] = DATA_AXIS
+    return P(*entries)
+
+
+def update_pspecs(params: Any, mesh: Mesh) -> Any:
+    """PartitionSpec tree for the *update-phase* placement of gradients and
+    AdamW moments under ``--shard_update`` (ZeRO-2-style): each leaf's param
+    spec plus the 'data' axis on its best free divisible dim (see
+    :func:`_leaf_update_pspec`). Constraining the accumulated gradient to
+    this placement turns the grad all-reduce into a reduce-scatter; keeping
+    the moments here makes each replica's optimizer state ~1/data of the
+    replicated layout."""
+    data_size = mesh.shape[DATA_AXIS] if DATA_AXIS in mesh.axis_names else 1
+    fsdp_size = mesh.shape[FSDP_AXIS] if FSDP_AXIS in mesh.axis_names else 1
+    tp_size = mesh.shape[TP_AXIS] if TP_AXIS in mesh.axis_names else 1
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _leaf_update_pspec(
+            path, leaf, data_size, fsdp_size, tp_size
+        ),
+        params,
+    )
+
+
+class ShardedUpdateSpec(NamedTuple):
+    """The three NamedSharding trees the sharded weight update needs.
+
+    ``grads`` is the update-phase (data-sharded) placement the accumulated
+    gradient is constrained to (reduce-scatter); ``opt_state`` places the
+    AdamW moments the same way; ``params`` is the steady-state param
+    placement the fresh params are constrained back to (all-gather).
+    """
+
+    grads: Any
+    params: Any
+    opt_state: Any
+
+
+def sharded_update_spec(
+    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh
+) -> ShardedUpdateSpec:
+    """Build the :class:`ShardedUpdateSpec` for ``make_train_step``."""
+    return ShardedUpdateSpec(
+        grads=_to_named(update_pspecs(params, mesh), mesh),
+        params=_to_named(param_pspecs(params, mesh), mesh),
+        opt_state=opt_state_shardings(params, optimizer, mesh,
+                                      shard_update=True),
+    )
+
+
 def batch_pspec(leading_accum_axis: bool = True) -> P:
     """Batch sharding: the batch dim is split over BOTH mesh axes — under pure
     FSDP the mesh is (1, N) so this reproduces torch FULL_SHARD's
@@ -135,13 +218,27 @@ def batch_pspec(leading_accum_axis: bool = True) -> P:
 
 
 def opt_state_pspecs(
-    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh
+    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh,
+    shard_update: bool = False,
 ) -> Any:
     """PartitionSpec tree for the optimizer state: every param-shaped moment
-    (AdamW mu/nu) gets its parameter's spec, every non-param leaf (step
-    counters) is replicated. This is ZeRO-1/2 semantics — optimizer state is
-    sharded exactly as far as params are."""
-    pspecs = param_pspecs(params, mesh)
+    (AdamW mu/nu) gets a spec, every non-param leaf (step counters) is
+    replicated.
+
+    Default (``shard_update=False``): moments are placed exactly like their
+    params. That is ZeRO-3 semantics only as far as params themselves are
+    sharded — under 'fsdp' the moments shard with the weights, but in a
+    pure-DP mesh params are replicated and so is the optimizer state (every
+    replica redundantly holds and updates 2x params of moments).
+
+    ``shard_update=True`` is the ZeRO-1/2 placement for that DP case: moments
+    follow :func:`update_pspecs` (the 'data' axis layered onto each leaf),
+    so each replica holds ~1/data of the optimizer state and updates only
+    its shard (see :func:`sharded_update_spec` / ``--shard_update``)."""
+    pspecs = (
+        update_pspecs(params, mesh) if shard_update
+        else param_pspecs(params, mesh)
+    )
     state_shapes = jax.eval_shape(optimizer.init, params)
     return optax.tree_map_params(
         optimizer,
@@ -161,20 +258,26 @@ def _to_named(tree: Any, mesh: Mesh) -> Any:
 
 
 def opt_state_shardings(
-    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh
+    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh,
+    shard_update: bool = False,
 ) -> Any:
     """NamedSharding tree for the optimizer state (see opt_state_pspecs)."""
-    return _to_named(opt_state_pspecs(params, optimizer, mesh), mesh)
+    return _to_named(
+        opt_state_pspecs(params, optimizer, mesh, shard_update=shard_update),
+        mesh,
+    )
 
 
 def shard_params_and_opt_state(
-    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh
+    params: Any, optimizer: optax.GradientTransformation, mesh: Mesh,
+    shard_update: bool = False,
 ) -> tuple[Any, Any, Any, Any]:
     """Place params on the mesh per the param rule and build the optimizer
-    state sharded like its params. The moment shardings are enforced with
-    explicit ``out_shardings`` — jit does NOT propagate input shardings to
-    outputs reliably (XLA may replicate them), which would silently give up
-    ZeRO and triple per-device optimizer memory.
+    state sharded like its params (or, with ``shard_update=True``, in the
+    data-sharded update-phase layout of :func:`update_pspecs`). The moment
+    shardings are enforced with explicit ``out_shardings`` — jit does NOT
+    propagate input shardings to outputs reliably (XLA may replicate them),
+    which would silently give up ZeRO and triple per-device optimizer memory.
 
     Returns ``(sharded_params, sharded_opt_state, param_shardings,
     opt_shardings)`` — both sharding trees, so callers (e.g. checkpoint
@@ -182,9 +285,34 @@ def shard_params_and_opt_state(
     """
     shardings = _to_named(param_pspecs(params, mesh), mesh)
     params = jax.device_put(params, shardings)
-    opt_shardings = opt_state_shardings(params, optimizer, mesh)
+    opt_shardings = opt_state_shardings(
+        params, optimizer, mesh, shard_update=shard_update
+    )
     opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
     return params, opt_state, shardings, opt_shardings
+
+
+def resolve_shard_update(mode: str, mesh: Mesh) -> bool:
+    """Resolve a ``--shard_update {off,on,auto}`` flag against the mesh.
+
+    'auto' enables the sharded update exactly when it is the missing mode:
+    a real 'data' axis with no 'fsdp' sharding (fsdp already shards the
+    optimizer state; stacking 'data' on top is legal but untested territory
+    that 'on' can force). Any mode degrades to off at data=1 — there is
+    nothing to shard and the constraints would be pure no-op noise in the
+    HLO.
+    """
+    if mode not in ("off", "on", "auto"):
+        raise ValueError(
+            f"shard_update={mode!r}: expected 'off', 'on' or 'auto'"
+        )
+    data_size = mesh.shape[DATA_AXIS] if DATA_AXIS in mesh.axis_names else 1
+    fsdp_size = mesh.shape[FSDP_AXIS] if FSDP_AXIS in mesh.axis_names else 1
+    if mode == "off" or data_size <= 1:
+        return False
+    if mode == "on":
+        return True
+    return fsdp_size == 1
 
 
 def shard_batch(batch: Any, mesh: Mesh, leading_accum_axis: bool = True) -> Any:

@@ -79,79 +79,21 @@ class GuardedStepMetrics(NamedTuple):
     clipped: jnp.ndarray        # int32, 1 iff THIS step was clip-applied
 
 
-def make_train_step(
+def _make_accumulate_grads(
     config: GPT2Config,
-    optimizer: optax.GradientTransformation,
-    compute_dtype: jnp.dtype = jnp.bfloat16,
-    donate: bool = True,
-    unroll_accum: bool = False,
-    accum_dtype: jnp.dtype | None = None,
-    guard: bool = False,
-    clip_threshold: float | None = None,
-    layer_clip_norm: float = 1.0,
+    compute_dtype: jnp.dtype,
+    unroll_accum: bool,
+    accum_dtype: jnp.dtype | None,
+    grad_shardings: Any = None,
 ) -> Callable:
-    """Build the jitted train step.
-
-    Signature of the returned function::
-
-        new_params, new_opt_state, metrics = step(
-            params, opt_state, x, y, rng, step_idx)
-
-    where ``x, y`` are int32 ``[grad_accum, micro_batch, seq_len]`` and ``rng``
-    is a per-run PRNG key (per-step dropout keys are derived by folding in
-    ``step_idx`` and the micro-batch index, so resume from a checkpoint
-    reproduces the same dropout masks).
-
-    Works under any sharding: batch sharded over the mesh makes the loss/grads
-    global automatically (XLA inserts the psum), params sharded over 'fsdp'
-    makes this the ZeRO-3 schedule. Params and opt_state buffers are donated —
-    the update is in-place in HBM, like the reference's fused optimizer.
-
-    ``accum_dtype`` sets the cross-micro-batch gradient accumulator's dtype
-    (None = the params' fp32 — torch-autocast parity, where ``.grad`` stays
-    fp32). ``jnp.bfloat16`` halves the accumulator carry — the knob that
-    gives single-chip 774M any accum > 1 at all (the fp32 carry alone is
-    3.1 GiB, PRESETS_MEMORY.md) — similar in spirit to (not the same
-    rounding as) the reference FSDP's bf16 gradient handling: torch's
-    ``MixedPrecision(reduce_dtype=bf16)``
-    (``/root/reference/train_gpt2_distributed.py:151-155``) is a ONE-SHOT
-    cross-rank reduction of each backward's grads, whereas this carry is a
-    *sequential running bf16 sum* over up to ``accum`` micro-steps of
-    1/accum-scaled grads — later addends lose low-order bits against a
-    growing carry, so the rounding compounds with depth (and accum counts
-    deeper than the measured 8 widen the bound further). Opt-in (CLI/bench
-    ``--accum_dtype bf16``): expect ~1e-2-relative gradient rounding
-    (pinned by ``test_bf16_accum_tracks_fp32_accum``); the AdamW update
-    itself still runs on fp32 (the carry is upcast before
-    ``optimizer.update``).
-
-    ``guard=True`` builds the resilient production step (``resilience.py``
-    layer 1): signature becomes ::
-
-        new_params, new_opt_state, new_guard_state, metrics = step(
-            params, opt_state, guard_state, x, y, rng, step_idx, loss_scale)
-
-    where ``guard_state`` is a :class:`resilience.GuardState` and
-    ``loss_scale`` is a ``[grad_accum]`` fp32 vector multiplied into each
-    micro-batch's loss (all-ones in production; ``--inject_nan_at`` poisons
-    one entry to fault-inject a non-finite step). The optimizer update is
-    ``lax.cond``-gated on ``isfinite(loss) & isfinite(grad_norm)``: a
-    non-finite step returns params/opt-state *bit-unchanged* (identity
-    update), bumps ``skipped_steps`` and records the SKIP_* reason code —
-    both also mirrored into :class:`GuardedStepMetrics` so the host can read
-    them with the usual one-step lag without touching the donated state.
-
-    ``clip_threshold`` (guard mode only) adds the middle response between
-    "apply as-is" and "skip outright" (ROADMAP resilience item c): a step
-    whose gradient is *finite* but whose global norm exceeds the threshold
-    is not discarded — each gradient leaf ("layer") is clipped to L2 norm
-    ``layer_clip_norm`` and the update applies. Per-layer rather than global
-    rescale: a single exploding layer (the common case — one attention block
-    hitting a bad batch) is tamed without crushing every other layer's
-    signal by the shared global factor. Non-finite values still skip — no
-    amount of rescaling repairs a NaN. Clipped steps count in
-    ``clipped_steps`` (GuardState + metrics), not ``skipped_steps``.
-    """
+    """Build the loss->grad->accumulate closure shared by the train and
+    accum-only steps. ``grad_shardings`` (a param-shaped NamedSharding tree)
+    constrains the post-scan accumulated gradient — the ``--shard_update``
+    hook: with the data-sharded update placement, GSPMD turns the gradient
+    all-reduce into a reduce-scatter and ``optax.global_norm`` below it into
+    per-shard partial square-sums plus one scalar psum. The constraint sits
+    OUTSIDE the micro-batch scan on purpose: gradients still cross the
+    network once per optimizer step, never per micro-batch."""
 
     def accumulate_grads(params, x, y, rng, step_idx, loss_scale=None):
         step_rng = jax.random.fold_in(rng, step_idx)
@@ -224,8 +166,120 @@ def make_train_step(
         grads = jax.tree_util.tree_map(
             lambda g, p: g.astype(p.dtype), grads, params
         )
+        if grad_shardings is not None:
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
         grad_norm = optax.global_norm(grads)
         return grads, loss, grad_norm
+
+    return accumulate_grads
+
+
+def make_train_step(
+    config: GPT2Config,
+    optimizer: optax.GradientTransformation,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    donate: bool = True,
+    unroll_accum: bool = False,
+    accum_dtype: jnp.dtype | None = None,
+    guard: bool = False,
+    clip_threshold: float | None = None,
+    layer_clip_norm: float = 1.0,
+    sharded_update: Any = None,
+) -> Callable:
+    """Build the jitted train step.
+
+    Signature of the returned function::
+
+        new_params, new_opt_state, metrics = step(
+            params, opt_state, x, y, rng, step_idx)
+
+    where ``x, y`` are int32 ``[grad_accum, micro_batch, seq_len]`` and ``rng``
+    is a per-run PRNG key (per-step dropout keys are derived by folding in
+    ``step_idx`` and the micro-batch index, so resume from a checkpoint
+    reproduces the same dropout masks).
+
+    Works under any sharding: batch sharded over the mesh makes the loss/grads
+    global automatically (XLA inserts the psum), params sharded over 'fsdp'
+    makes this the ZeRO-3 schedule. Params and opt_state buffers are donated —
+    the update is in-place in HBM, like the reference's fused optimizer.
+
+    ``accum_dtype`` sets the cross-micro-batch gradient accumulator's dtype
+    (None = the params' fp32 — torch-autocast parity, where ``.grad`` stays
+    fp32). ``jnp.bfloat16`` halves the accumulator carry — the knob that
+    gives single-chip 774M any accum > 1 at all (the fp32 carry alone is
+    3.1 GiB, PRESETS_MEMORY.md) — similar in spirit to (not the same
+    rounding as) the reference FSDP's bf16 gradient handling: torch's
+    ``MixedPrecision(reduce_dtype=bf16)``
+    (``/root/reference/train_gpt2_distributed.py:151-155``) is a ONE-SHOT
+    cross-rank reduction of each backward's grads, whereas this carry is a
+    *sequential running bf16 sum* over up to ``accum`` micro-steps of
+    1/accum-scaled grads — later addends lose low-order bits against a
+    growing carry, so the rounding compounds with depth (and accum counts
+    deeper than the measured 8 widen the bound further). Opt-in (CLI/bench
+    ``--accum_dtype bf16``): expect ~1e-2-relative gradient rounding
+    (pinned by ``test_bf16_accum_tracks_fp32_accum``); the AdamW update
+    itself still runs on fp32 (the carry is upcast before
+    ``optimizer.update``).
+
+    ``guard=True`` builds the resilient production step (``resilience.py``
+    layer 1): signature becomes ::
+
+        new_params, new_opt_state, new_guard_state, metrics = step(
+            params, opt_state, guard_state, x, y, rng, step_idx, loss_scale)
+
+    where ``guard_state`` is a :class:`resilience.GuardState` and
+    ``loss_scale`` is a ``[grad_accum]`` fp32 vector multiplied into each
+    micro-batch's loss (all-ones in production; ``--inject_nan_at`` poisons
+    one entry to fault-inject a non-finite step). The optimizer update is
+    ``lax.cond``-gated on ``isfinite(loss) & isfinite(grad_norm)``: a
+    non-finite step returns params/opt-state *bit-unchanged* (identity
+    update), bumps ``skipped_steps`` and records the SKIP_* reason code —
+    both also mirrored into :class:`GuardedStepMetrics` so the host can read
+    them with the usual one-step lag without touching the donated state.
+
+    ``clip_threshold`` (guard mode only) adds the middle response between
+    "apply as-is" and "skip outright" (ROADMAP resilience item c): a step
+    whose gradient is *finite* but whose global norm exceeds the threshold
+    is not discarded — each gradient leaf ("layer") is clipped to L2 norm
+    ``layer_clip_norm`` and the update applies. Per-layer rather than global
+    rescale: a single exploding layer (the common case — one attention block
+    hitting a bad batch) is tamed without crushing every other layer's
+    signal by the shared global factor. Non-finite values still skip — no
+    amount of rescaling repairs a NaN. Clipped steps count in
+    ``clipped_steps`` (GuardState + metrics), not ``skipped_steps``.
+
+    ``sharded_update`` (a ``sharding.ShardedUpdateSpec``) enables the
+    ZeRO-2-style cross-replica sharded weight update (``--shard_update``):
+    the accumulated gradient is constrained to the data-sharded update
+    placement (reduce-scatter), AdamW runs on 1/data-sized gradient/moment
+    shards (weight decay slices the replicated params for free), and the
+    fresh params are constrained back to the steady-state placement
+    (all-gather) — applied AFTER the guard's ``lax.switch``, so all three
+    branches unify under one constraint and the identity (skip) branch stays
+    a bit-identical no-op (its inputs already carry exactly these
+    shardings). Composes with ``accum_dtype`` (the constraint sits after the
+    fp32 upcast) and with per-layer clip (clip_leaf's per-leaf norm becomes
+    a sharded partial-sum + psum, same value).
+    """
+
+    grad_shardings = (
+        sharded_update.grads if sharded_update is not None else None
+    )
+    accumulate_grads = _make_accumulate_grads(
+        config, compute_dtype, unroll_accum, accum_dtype, grad_shardings
+    )
+
+    def constrain_state(new_params, new_opt_state):
+        if sharded_update is None:
+            return new_params, new_opt_state
+        return (
+            jax.lax.with_sharding_constraint(
+                new_params, sharded_update.params
+            ),
+            jax.lax.with_sharding_constraint(
+                new_opt_state, sharded_update.opt_state
+            ),
+        )
 
     if not guard:
 
@@ -233,6 +287,9 @@ def make_train_step(
             grads, loss, grad_norm = accumulate_grads(params, x, y, rng, step_idx)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            new_params, new_opt_state = constrain_state(
+                new_params, new_opt_state
+            )
             return new_params, new_opt_state, StepMetrics(
                 loss=loss, grad_norm=grad_norm
             )
@@ -295,6 +352,7 @@ def make_train_step(
             [apply_update, clip_apply_update, identity_update],
             None,
         )
+        new_params, new_opt_state = constrain_state(new_params, new_opt_state)
         skipped = (branch == 2).astype(jnp.int32)
         clipped_now = (branch == 1).astype(jnp.int32)
         # A non-finite grad_norm under a finite loss (0*inf in the backward)
@@ -327,6 +385,33 @@ def make_train_step(
     return jax.jit(
         guarded_train_step, donate_argnums=(0, 1, 2) if donate else ()
     )
+
+
+def make_accum_step(
+    config: GPT2Config,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    unroll_accum: bool = False,
+    accum_dtype: jnp.dtype | None = None,
+) -> Callable:
+    """Jitted forward+backward+accumulate+grad-norm with NO optimizer update.
+
+    ``(loss, grad_norm) = accum_step(params, x, y, rng, step_idx)`` — the
+    same accumulation HLO as the train step (grad_norm keeps the backward
+    alive against DCE), minus the AdamW update and state write-back. Exists
+    so bench.py can step-delta the update phase: ``update_ms = full-step ms −
+    this function's ms`` — the honest way to attribute the replicated-vs-
+    sharded update cost without a device trace. Params are NOT donated (the
+    caller reuses them across timing reps).
+    """
+    accumulate_grads = _make_accumulate_grads(
+        config, compute_dtype, unroll_accum, accum_dtype
+    )
+
+    def accum_step(params, x, y, rng, step_idx):
+        _, loss, grad_norm = accumulate_grads(params, x, y, rng, step_idx)
+        return loss, grad_norm
+
+    return jax.jit(accum_step)
 
 
 def make_eval_step(

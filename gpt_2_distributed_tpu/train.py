@@ -90,6 +90,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="attention kernel (default: the preset's 'auto' policy — ring "
         "when the mesh has sp>1, flash on TPU, dense otherwise)",
     )
+    p.add_argument(
+        "--shard_update", default="auto", choices=["off", "on", "auto"],
+        help="ZeRO-2-style cross-replica sharded weight update "
+        "(parallel/sharding.py update_pspecs): reduce-scatter the "
+        "accumulated gradient over the 'data' axis, keep the AdamW moments "
+        "and the update sharded (~1/data optimizer memory and update "
+        "flops), all-gather the fresh params — same comms volume as the "
+        "grad all-reduce. 'auto' (default) enables it on meshes with "
+        "data>1 and fsdp==1, where the update is otherwise fully "
+        "replicated; 'on' forces it on any data>1 mesh (composes with "
+        "fsdp); numerics match the replicated update to fp32 roundoff",
+    )
+    p.add_argument(
+        "--device_prefetch", default="on", choices=["on", "off"],
+        help="device-side double-buffered batch prefetch: issue the H2D "
+        "transfer (shard_batch) for optimizer step i+1 right after "
+        "dispatching step i, before blocking on step i-1's metrics, so the "
+        "host->device copy hides behind device compute. Identical batches "
+        "in identical order — numerics unchanged",
+    )
     p.add_argument("--model", default="124M", choices=sorted(MODEL_PRESETS))
     # Architecture overrides on top of the preset (smoke tests / ablations);
     # the reference exposes no size control at all (SURVEY.md §5.6).
@@ -511,8 +531,10 @@ def main(argv: list[str] | None = None) -> None:
     from gpt_2_distributed_tpu.models import gpt2
     from gpt_2_distributed_tpu.ops.spmd import fused_fallback_count
     from gpt_2_distributed_tpu.parallel.sharding import (
+        resolve_shard_update,
         shard_batch,
         shard_params_and_opt_state,
+        sharded_update_spec,
     )
     from gpt_2_distributed_tpu.parallel.train_step import (
         make_eval_step,
@@ -551,6 +573,7 @@ def main(argv: list[str] | None = None) -> None:
     except ValueError as e:
         raise SystemExit(f"error: {e}") from None
     mesh = create_mesh(spec)
+    use_shard_update = resolve_shard_update(args.shard_update, mesh)
     # --batch is per device (DDP parity: the reference's --batch is per GPU
     # process); each host's loader assembles the slice its local devices own.
     devices_per_process = max(1, spec.n_devices // jax.process_count())
@@ -579,6 +602,8 @@ def main(argv: list[str] | None = None) -> None:
         extra = ""
         if spec.sp > 1 or spec.tp > 1:
             extra = f", sp={spec.sp}, tp={spec.tp}"
+        if use_shard_update:
+            extra += ", shard_update"
         print(
             f"mesh: data={spec.data}, fsdp={spec.fsdp}{extra} | "
             f"model: {args.model} "
@@ -602,17 +627,24 @@ def main(argv: list[str] | None = None) -> None:
 
     with activate_mesh(mesh):
         params, opt_state, param_shardings, opt_shardings = (
-            shard_params_and_opt_state(params, optimizer, mesh)
+            shard_params_and_opt_state(
+                params, optimizer, mesh, shard_update=use_shard_update
+            )
         )
         import jax.numpy as jnp
 
         use_guard = args.step_guard == "on"
+        device_prefetch = args.device_prefetch == "on"
         train_step = make_train_step(
             config, optimizer,
             accum_dtype=jnp.bfloat16 if args.accum_dtype == "bf16" else None,
             guard=use_guard,
             clip_threshold=args.guard_max_grad_norm or None,
             layer_clip_norm=args.guard_clip_norm,
+            sharded_update=(
+                sharded_update_spec(params, optimizer, mesh)
+                if use_shard_update else None
+            ),
         )
         guard_state = init_guard_state() if use_guard else None
         monitor = (
@@ -1065,6 +1097,11 @@ def main(argv: list[str] | None = None) -> None:
                 loader_iter = iter(loader)
                 worker_error: BaseException | None = None
                 first_inner_iter = True
+                # Double-buffer slot for --device_prefetch: the NEXT step's
+                # batch, already sharded onto devices (H2D issued while the
+                # previous step computes). Host-side `micro` stays the source
+                # of truth for last_micro replay.
+                prefetched_dev = None
                 while step_in_epoch < epoch_opt_steps:
                     # (1) Host-local fetch of one optimizer step's
                     # micro-batches. Deliberately NOT a collective: a host
@@ -1259,11 +1296,17 @@ def main(argv: list[str] | None = None) -> None:
                         # horizon only matters if the watchdog is broken.
                         time.sleep(coord_policy.hang_timeout_s * 20 + 30)
 
-                    x = np.stack([m[0] for m in micro])
-                    y = np.stack([m[1] for m in micro])
                     last_micro = micro  # replay source if a worker dies mid-interval
+                    if prefetched_dev is not None:
+                        # --device_prefetch issued this batch's H2D during the
+                        # previous step's compute; consume it as-is.
+                        x, y = prefetched_dev
+                        prefetched_dev = None
+                    else:
+                        x = np.stack([m[0] for m in micro])
+                        y = np.stack([m[1] for m in micro])
+                        x, y = shard_batch((x, y), mesh)
                     micro = []
-                    x, y = shard_batch((x, y), mesh)
                     if use_guard:
                         loss_scale = ones_scale
                         if (
@@ -1291,6 +1334,52 @@ def main(argv: list[str] | None = None) -> None:
                         )
                     global_step += 1
                     step_in_epoch += 1
+                    # Device-side double-buffered prefetch (--device_prefetch):
+                    # step i was just dispatched and the host is about to
+                    # block on step i-1's metrics in flush_pending — fetch
+                    # step i+1's micro-batches and issue their H2D transfer
+                    # NOW, so the copy overlaps device compute instead of
+                    # serializing after the metrics wait. Failures route
+                    # exactly like the top-of-loop fetch: StopIteration
+                    # leaves the partial tail for the top of the next
+                    # iteration to re-raise (generators keep raising), a dead
+                    # worker raises single-host and latches worker_error for
+                    # the consensus exchange multi-host. Skipped when the
+                    # loop is about to exit — no batch is pulled past the
+                    # epoch/max_steps boundary.
+                    if (
+                        device_prefetch
+                        and worker_error is None
+                        and step_in_epoch < epoch_opt_steps
+                        and not (
+                            args.max_steps and global_step >= args.max_steps
+                        )
+                    ):
+                        try:
+                            while len(micro) < args.grad_accum_steps:
+                                xb, yb = next(loader_iter)
+                                micro.append((xb, yb))
+                            prefetched_dev = shard_batch(
+                                (
+                                    np.stack([m[0] for m in micro]),
+                                    np.stack([m[1] for m in micro]),
+                                ),
+                                mesh,
+                            )
+                        except StopIteration:
+                            pass
+                        except RuntimeError as exc:
+                            if not multihost:
+                                raise
+                            worker_error = exc
+                            cause = exc.__cause__
+                            detail = f"{exc}: {cause}" if cause else str(exc)
+                            print(
+                                f"[coord] local data worker failed during "
+                                f"prefetch ({detail}); requesting pod-wide "
+                                f"abort",
+                                flush=True,
+                            )
                     flush_pending()
                     pending = (global_step, epoch, step_in_epoch, m)
                     if watchdog is not None:

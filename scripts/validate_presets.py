@@ -66,8 +66,24 @@ CONFIGS_SINGLE_CHIP = [
     ("1.5B", "v5e:1x1", 1, 1, 1, 8, "block"),
 ]
 
+# Pure-DP single-host (8-chip) rows: the --shard_update comparison. In dp
+# mode the AdamW moments (8 B/param) are REPLICATED on every chip —
+# 2.64 GiB at 345M, 5.77 GiB at 774M — and the sharded update cuts them to
+# moments/8 (0.33 / 0.72 GiB), which is exactly the headroom that decides
+# whether the larger accum operating points fit. off/on pairs compile the
+# same step both ways so the delta is the claim, not an estimate.
+# (..., remat, accum_dtype, shard_update)
+CONFIGS_DP = [
+    ("345M", "v5e:2x4", 8, 1, 8, 8, False, "fp32", "off"),
+    ("345M", "v5e:2x4", 8, 1, 8, 8, False, "fp32", "on"),
+    ("774M", "v5e:2x4", 8, 1, 8, 8, "block", "bf16", "off"),
+    ("774M", "v5e:2x4", 8, 1, 8, 8, "block", "bf16", "on"),
+    ("774M", "v5e:2x4", 8, 1, 8, 8, "block", "fp32", "on"),
+]
 
-def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat, accum_dtype="fp32"):
+
+def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat,
+                accum_dtype="fp32", shard_update="off"):
     import jax
     import jax.numpy as jnp
     import jax.tree_util as jtu
@@ -104,8 +120,10 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat, accum_dtype="fp
     opt = make_optimizer(1e-4)
     params_shape = jax.eval_shape(lambda: gpt2.init_params(cfg))
     opt_shape = jax.eval_shape(opt.init, params_shape)
+    use_shard_update = shard_update == "on"
     pshard = sh._to_named(sh.param_pspecs(params_shape, mesh), mesh)
-    oshard = sh.opt_state_shardings(params_shape, opt, mesh)
+    oshard = sh.opt_state_shardings(
+        params_shape, opt, mesh, shard_update=use_shard_update)
     bshard = NamedSharding(mesh, sh.batch_pspec())
     p_in = jtu.tree_map(
         lambda s, d: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=d),
@@ -122,14 +140,25 @@ def aot_compile(preset, topo_name, data, fsdp, mb, accum, remat, accum_dtype="fp
     step = make_train_step(
         cfg, opt,
         accum_dtype=jnp.bfloat16 if accum_dtype == "bf16" else None,
+        sharded_update=(
+            sh.sharded_update_spec(params_shape, opt, mesh)
+            if use_shard_update else None),
     )
     n_params = sum(
         int(np.prod(s.shape)) for s in jtu.tree_leaves(params_shape))
+    # Per-chip optimizer-state bytes straight from the shardings (the /N
+    # claim the dp table exists to demonstrate): each leaf contributes its
+    # shard shape — replicated leaves count full size.
+    opt_state_gib_per_chip = sum(
+        int(np.prod(d.shard_shape(s.shape))) * s.dtype.itemsize
+        for s, d in zip(jtu.tree_leaves(opt_shape), jtu.tree_leaves(oshard))
+    ) / 2**30
 
     row = {
         "preset": preset, "topology": topo_name, "mesh": [data, fsdp],
         "micro_batch_per_chip": mb, "grad_accum": accum, "remat": str(remat),
-        "accum_dtype": accum_dtype,
+        "accum_dtype": accum_dtype, "shard_update": shard_update,
+        "opt_state_gib_per_chip": round(opt_state_gib_per_chip, 2),
         "n_params": n_params,
     }
     try:
@@ -168,12 +197,18 @@ def main():
         "--skip_single_chip", action="store_true",
         help="skip the single-chip 774M/1.5B operating-point sweep",
     )
+    p.add_argument(
+        "--skip_dp", action="store_true",
+        help="skip the pure-DP --shard_update off/on comparison sweep",
+    )
     args = p.parse_args()
 
     configs = CONFIGS[:1] if args.quick else CONFIGS
     single = [] if (args.quick or args.skip_single_chip) else CONFIGS_SINGLE_CHIP
+    dp = [] if (args.quick or args.skip_dp) else CONFIGS_DP
     rows = []
     single_rows = []
+    dp_rows = []
     for cfg in configs:
         r = aot_compile(*cfg)
         rows.append(r)
@@ -181,6 +216,10 @@ def main():
     for cfg in single:
         r = aot_compile(*cfg)
         single_rows.append(r)
+        print(json.dumps(r), flush=True)
+    for cfg in dp:
+        r = aot_compile(*cfg)
+        dp_rows.append(r)
         print(json.dumps(r), flush=True)
 
     lines = [
@@ -262,6 +301,33 @@ def main():
             "36.5%; sublayer remat (mlp/attn) OOMs everywhere tried",
             "(16.6-29.1G) on both compilers.",
         ]
+    if dp_rows:
+        lines += [
+            "",
+            "## Pure-DP 8-chip rows: `--shard_update` off vs on",
+            "",
+            "In a `data`-only mesh the fits rule changes: replicated state",
+            "costs 12 B/param per chip (4 B master + 8 B AdamW moments) while",
+            "`--shard_update on` keeps the moments sharded 1/N — per-chip",
+            "optimizer state drops to 4 + 8/N B/param (N=8 here: 345M saves",
+            "~2.3 GiB/chip, 774M ~5.1 GiB/chip). The `opt state` column is",
+            "computed from the actual leaf shardings, not estimated; off/on",
+            "pairs compile the identical step so the peak delta is the",
+            "headroom the sharded update buys for larger accum/micro-batch.",
+            "",
+            "| preset | mesh (data,fsdp) | micro-batch/chip | accum | remat "
+            "| carry | shard_update | opt state GiB/chip | peak GiB/chip "
+            "| fits |",
+            "|" + "---|" * 10,
+        ]
+        for r in dp_rows:
+            lines.append(
+                f"| {r['preset']} | {tuple(r['mesh'])} "
+                f"| {r['micro_batch_per_chip']} | {r['grad_accum']} "
+                f"| {r['remat']} | {r['accum_dtype']} | {r['shard_update']} "
+                f"| {r['opt_state_gib_per_chip']} "
+                f"| {r['peak_gib_per_chip']} | {'yes' if r['fits'] else 'NO'} |"
+            )
     with open("PRESETS_MEMORY.md", "w") as f:
         f.write("\n".join(lines) + "\n")
     print("wrote PRESETS_MEMORY.md")

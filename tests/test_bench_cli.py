@@ -208,3 +208,35 @@ def test_default_suite_rejects_operating_point_overrides(tmp_path):
         r = _run(*flags, poison_jax_dir=poison)
         assert r.returncode != 0, flags
         assert named in r.stderr, (flags, r.stderr[-300:])
+
+
+def test_shard_update_rejected_in_suite_and_forwarded_resilient(monkeypatch, tmp_path):
+    # --shard_update is an operating-point override like the rest: suite
+    # mode rejects a non-default value at parse time (records must stay
+    # comparable round-over-round; the mode is carried in-record), and the
+    # resilient child subprocess gets it forwarded verbatim.
+    r = _run("--shard_update", "on", poison_jax_dir=_poison(tmp_path))
+    assert r.returncode != 0
+    assert "--shard_update" in r.stderr
+
+    bench = _import_bench()
+    calls = []
+
+    def fake_run(cmd, **kwargs):
+        calls.append(cmd)
+
+        class R:
+            returncode = 0
+            stdout = '{"value": 1.0}\n'
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    args = bench.argparse.Namespace(steps=30, warmup=2, shard_update="auto")
+    bench.run_config_resilient(args, model="124M", seq_len=1024)
+    assert "--shard_update" in calls[0] and "auto" in calls[0], calls[0]
+    # Default ("off") forwards nothing.
+    calls.clear()
+    bench.run_config_resilient(_suite_args(bench), model="124M", seq_len=1024)
+    assert "--shard_update" not in calls[0], calls[0]
