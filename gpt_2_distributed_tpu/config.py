@@ -249,6 +249,66 @@ class CoordinationPolicy:
             )
 
 
+@dataclass(frozen=True)
+class ServeConfig:
+    """Serving-engine shape signature + scheduler policy
+    (``gpt_2_distributed_tpu/serving/engine.py``).
+
+    Run-level like :class:`CheckpointPolicy` — it describes a serving
+    deployment, not the model. The triple ``(max_batch, num_blocks,
+    block_size)`` IS the decode step's compile signature: every admission,
+    eviction and block-table rewrite changes array *contents* only, so the
+    engine's decode step compiles exactly once per ServeConfig (asserted by
+    jit cache-miss counting in tests/test_serving.py).
+
+    * ``max_batch`` — in-flight decode slots; the continuous-batching
+      scheduler admits queued requests into free slots at step boundaries.
+    * ``block_size`` — KV positions per pool block. Smaller blocks waste
+      less capacity on short sequences (internal fragmentation is at most
+      ``block_size - 1`` positions/sequence) but widen the block table; on
+      real TPUs a multiple of 8 keeps the Pallas kernel's [bs, D] tiles
+      sublane-aligned (128 is the MXU-friendly choice).
+    * ``num_blocks`` — pool capacity. Block 0 is reserved as the null
+      block: idle slots and table tails park there, so the paged kernels
+      never index out of bounds. Usable KV capacity is
+      ``(num_blocks - 1) * block_size`` positions.
+    * ``attn_impl`` — paged_attention dispatch: "auto" (Pallas on TPU, XLA
+      gather elsewhere), or forced "xla"/"pallas".
+    * ``eos_id`` — generation stops (and the slot + blocks are reclaimed)
+      when this token is sampled; None = run every request to its
+      max_new_tokens.
+    """
+
+    max_batch: int = 8
+    block_size: int = 16
+    num_blocks: int = 256
+    attn_impl: str = "auto"
+    eos_id: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch={self.max_batch} must be >= 1")
+        if self.block_size < 1:
+            raise ValueError(f"block_size={self.block_size} must be >= 1")
+        if self.num_blocks < 2:
+            raise ValueError(
+                f"num_blocks={self.num_blocks} must be >= 2 (block 0 is the "
+                f"reserved null block)"
+            )
+        if self.attn_impl not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"attn_impl={self.attn_impl!r}: expected 'auto', 'xla' or "
+                f"'pallas'"
+            )
+        if self.eos_id is not None and self.eos_id < 0:
+            raise ValueError(f"eos_id={self.eos_id} must be >= 0")
+
+    def max_blocks_per_seq(self, n_positions: int) -> int:
+        """Static block-table width: enough blocks for a full-context
+        sequence."""
+        return -(-n_positions // self.block_size)
+
+
 # BASELINE.json configs 1-5 require these four sizes; the standard GPT-2 family.
 MODEL_PRESETS: dict[str, GPT2Config] = {
     "124M": GPT2Config(n_layer=12, n_embd=768, n_head=12),

@@ -49,15 +49,15 @@ class KVCache(NamedTuple):
     v: jnp.ndarray  # [L, B, H, S, D]
 
 
-def _prefill(
+def prefill(
     params,
     config: GPT2Config,
     prompt: jnp.ndarray,  # [B, P] int32
     total: int,
     compute_dtype: jnp.dtype,
 ) -> tuple[jnp.ndarray, KVCache]:
-    """Run the prompt through the block stack once; return the final-position
-    hidden state [B, C] and a cache of size ``total`` holding K/V for
+    """Run the prompt through the block stack once; return the post-ln_f
+    hidden states [B, P, C] and a cache of size ``total`` holding K/V for
     positions [0, P).
 
     Mirrors ``gpt2.hidden_states`` (same sublayer math, deterministic) but
@@ -66,6 +66,14 @@ def _prefill(
     (which cannot return K/V without widening its training-path signature);
     any structural change there must land here too — the teacher-forcing
     parity test in tests/test_decode.py enforces the mirror.
+
+    Shared by ``generate_cached`` below (which reads hidden row P-1 and the
+    contiguous cache) and the serving engine's admission prefill
+    (``serving/engine.py`` — which reads the row of the REAL last prompt
+    position under right-padding, then scatters the K/V into pool blocks).
+    Full hidden states are returned rather than just the last row so the
+    padded-prompt caller can slice its own position; the [B, P, C] tensor
+    already existed — this widens the return, not the compute.
     """
     b, p = prompt.shape
     h, d = config.n_head, config.head_dim
@@ -93,7 +101,7 @@ def _prefill(
 
     x, (kcs, vcs) = jax.lax.scan(body, x, params["block"])
     x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
-    return x[:, -1], KVCache(k=kcs, v=vcs)
+    return x, KVCache(k=kcs, v=vcs)
 
 
 def decode_step(
@@ -172,18 +180,18 @@ def generate_cached(
     ``generate.generate`` (identical greedy outputs, same PRNG split order),
     O(total) attention per new token instead of a full re-forward."""
     b, p = prompt.shape
-    total = check_generation_args(config, p, max_new_tokens, top_k)
+    total = check_generation_args(config, p, max_new_tokens, top_k, batch=b)
 
-    h_last, cache = _prefill(params, config, prompt, total, compute_dtype)
+    h, cache = prefill(params, config, prompt, total, compute_dtype)
     logits0 = jnp.einsum(
-        "bc,vc->bv", h_last, params["wte"].astype(h_last.dtype),
+        "bc,vc->bv", h[:, -1], params["wte"].astype(h.dtype),
         preferred_element_type=jnp.float32,
     )
     key, sub = jax.random.split(rng)
     first = sample_token(logits0, sub, temperature, top_k)
 
     ids = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
-    ids = ids.at[:, p].set(first) if max_new_tokens > 0 else ids
+    ids = ids.at[:, p].set(first)  # max_new_tokens >= 1 (validated above)
 
     def step(carry, t):
         ids, cache, key = carry

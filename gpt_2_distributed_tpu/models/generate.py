@@ -51,9 +51,30 @@ def sample_token(logits, key, temperature: float, top_k: int | None):
 
 
 def check_generation_args(
-    config: GPT2Config, prompt_len: int, max_new_tokens: int, top_k: int | None
+    config: GPT2Config,
+    prompt_len: int,
+    max_new_tokens: int,
+    top_k: int | None,
+    batch: int | None = None,
 ) -> int:
-    """Shared trace-time validation; returns the total sequence length."""
+    """Shared trace-time validation; returns the total sequence length.
+
+    THE bounds check for every generation surface: both decode paths
+    (``generate`` here, ``models/decode.py::generate_cached``) and the
+    serving engine's admission gate (``serving/engine.py::submit``) call
+    this, so a request the server would choke on is rejected with the same
+    ValueError everywhere. ``batch`` is optional because the decode paths
+    read it off the prompt shape; the server passes it explicitly per
+    admission."""
+    if batch is not None and batch < 1:
+        raise ValueError(f"batch={batch} must be >= 1")
+    if prompt_len < 1:
+        raise ValueError(f"prompt_len={prompt_len} must be >= 1")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens={max_new_tokens} must be >= 1 (a request that "
+            f"generates nothing is rejected at admission, not served)"
+        )
     total = prompt_len + max_new_tokens
     if total > config.n_positions:
         raise ValueError(
@@ -88,7 +109,7 @@ def generate(
     sampling to the k highest-probability tokens.
     """
     b, p = prompt.shape
-    total = check_generation_args(config, p, max_new_tokens, top_k)
+    total = check_generation_args(config, p, max_new_tokens, top_k, batch=b)
     # Fixed-size context buffer; unwritten tail is zeros (never attended to
     # by any position we read logits from).
     ids = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)

@@ -189,7 +189,7 @@ def _attn_sublayer(
 ) -> jnp.ndarray:
     """x + dropout(proj(attn(ln1(x)))).
 
-    NOTE: ``models/decode.py::_prefill`` mirrors this sublayer inline (it
+    NOTE: ``models/decode.py::prefill`` mirrors this sublayer inline (it
     must capture each layer's K/V projection, which this function discards).
     A change to the sublayer structure here — a new op, a moved dropout
     site — must be replicated there; the teacher-forcing logit-parity test

@@ -1,0 +1,244 @@
+"""Paged decode attention: one query row per sequence against a block pool.
+
+The serving subsystem (``gpt_2_distributed_tpu/serving/``) keeps every
+in-flight sequence's K/V in fixed-size blocks carved out of ONE preallocated
+device buffer (``[num_blocks, H, block_size, D]`` per layer), addressed
+through a per-sequence block table — so sequences of wildly different
+lengths share the buffer with no per-shape recompiles and no per-request
+contiguous allocation. This module is the attention op over that layout:
+
+    o[b] = softmax(q[b] · K[b]^T / sqrt(D)) · V[b]
+
+where K[b]/V[b] are the first ``lengths[b]`` positions of sequence ``b``,
+scattered across pool blocks ``block_table[b, :]``.
+
+Two implementations, one contract:
+
+* ``impl="xla"`` — gather the table's blocks into a contiguous
+  ``[B, H, S, D]`` view and run exactly the masked fp32 softmax the
+  contiguous-cache decode path runs (``models/decode.py::decode_step`` —
+  same einsums, same ``MASK_VALUE`` fill, same dtype round-trips), so the
+  paged path is testable bit-for-bit against the exactness reference.
+  The gather materializes the per-sequence K/V (HBM traffic ~2·B·S·H·D),
+  which is what the Pallas kernel exists to avoid.
+* ``impl="pallas"`` — a scalar-prefetch kernel reusing the block tiling
+  machinery of ``ops/flash_block.py`` (exp2-folded online softmax, m/l/acc
+  VMEM scratch carried over the column grid): the grid's block axis indexes
+  the POOL through the prefetched block table (``index_map`` reads
+  ``block_table[b, j]``), so each K/V block is DMA'd straight from its pool
+  slot — no gathered copy ever exists. Decode is forward-only, so unlike
+  flash_block there is no VJP; numerics differ from the XLA path by
+  online-softmax ulps (same contract as flash vs dense attention).
+
+Per-sequence lengths do the masking: position ``s`` of sequence ``b`` is
+attendable iff ``s < lengths[b]``. ``lengths[b] == 0`` marks an idle slot
+(o = 0) — pool blocks behind the table row are never read into the result.
+Block-table entries past a sequence's last block must point at a valid pool
+index (the serving layer parks them on the reserved null block 0); they are
+fetched but fully masked.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gpt_2_distributed_tpu.ops.attention import MASK_VALUE
+from gpt_2_distributed_tpu.ops.flash_attention import LOG2E, NEG_INF
+
+# jax 0.4.37 names this TPUCompilerParams; newer releases renamed it
+# (same resolve-once shim as ops/fused_layer.py).
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+_DIMS = ("parallel", "parallel", "arbitrary")  # j carries the m/l/acc scratch
+
+
+def paged_attention_xla(
+    q: jnp.ndarray,            # [B, H, D] compute dtype
+    k_pool: jnp.ndarray,       # [N, H, bs, D]
+    v_pool: jnp.ndarray,       # [N, H, bs, D]
+    block_table: jnp.ndarray,  # [B, M] int32 pool indices
+    lengths: jnp.ndarray,      # [B] int32 attendable positions (0 = idle)
+) -> jnp.ndarray:
+    """Gather-based reference path. Mirrors ``decode.decode_step``'s
+    attention bit-for-bit on the attendable prefix: identical einsum forms,
+    fp32 scores, ``MASK_VALUE`` fill (which underflows to exactly 0 after
+    the softmax max-subtract), probs cast back to the compute dtype."""
+    b, h, d = q.shape
+    m = block_table.shape[1]
+    bs = k_pool.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    # [B, M, H, bs, D] -> [B, H, M*bs, D]: the contiguous per-sequence view.
+    kc = k_pool[block_table].transpose(0, 2, 1, 3, 4).reshape(b, h, m * bs, d)
+    vc = v_pool[block_table].transpose(0, 2, 1, 3, 4).reshape(b, h, m * bs, d)
+
+    qh = q[:, :, None]                               # [B, H, 1, D]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", qh, kc, preferred_element_type=jnp.float32
+    ) * scale                                        # [B, H, 1, M*bs] fp32
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1, m * bs), 3)
+    mask = kpos < lengths[:, None, None, None]
+    scores = jnp.where(mask, scores, MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    # Idle slots (lengths == 0) softmax over an all-MASK_VALUE row to a
+    # uniform distribution; zero them explicitly so o is exactly 0.
+    probs = jnp.where(lengths[:, None, None, None] > 0, probs, 0.0)
+    o = jnp.einsum("bhqk,bhkd->bhqd", probs, vc)
+    return o[:, :, 0]                                # [B, H, D]
+
+
+def _paged_fwd_kernel(
+    bt_ref,       # scalar prefetch: [B, M] int32 block table
+    len_ref,      # scalar prefetch: [B] int32 lengths
+    q_ref,        # [1, 1, 1, D]
+    k_ref,        # [1, 1, bs, D] — pool block selected by the index_map
+    v_ref,        # [1, 1, bs, D]
+    o_ref,        # [1, 1, 1, D]
+    m_scr,        # VMEM [1, 1] f32
+    l_scr,        # VMEM [1, 1] f32
+    acc_scr,      # VMEM [1, D] f32
+    *,
+    block_size: int,
+):
+    b, j = pl.program_id(0), pl.program_id(2)
+    d = q_ref.shape[3]
+    scale = LOG2E / (d ** 0.5)
+    length = len_ref[b]
+    base = j * block_size
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Blocks wholly past the sequence contribute nothing — skip the math
+    # (the DMA already happened; table tails point at the null block).
+    @pl.when(base < length)
+    def _compute():
+        q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [1, bs] f32, base-2 logits
+        col = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = col < length
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp2(m_prev - m_new)
+        # Masked lanes must be forced to 0: on a row where every lane is
+        # masked m_new stays NEG_INF and exp2(s - m_new) would leak 1s
+        # (the same guard flash_block documents).
+        p = jnp.where(valid, jnp.exp2(s - m_new), 0.0)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0]
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[...]
+        has = l > 0.0
+        o_ref[0, 0] = jnp.where(
+            has, acc_scr[...] / jnp.maximum(l, 1e-37), 0.0
+        ).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(
+    q: jnp.ndarray,            # [B, H, D]
+    k_pool: jnp.ndarray,       # [N, H, bs, D]
+    v_pool: jnp.ndarray,       # [N, H, bs, D]
+    block_table: jnp.ndarray,  # [B, M] int32
+    lengths: jnp.ndarray,      # [B] int32
+    *,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Scalar-prefetch paged attention: K/V blocks stream from their pool
+    slots via the table-indexed ``index_map`` — the gathered contiguous
+    [B, H, S, D] view never materializes."""
+    b, h, d = q.shape
+    bs = k_pool.shape[2]
+    m = block_table.shape[1]
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, m),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d),
+                         lambda b_, h_, j, bt, ln: (b_, h_, 0, 0)),
+            # The paging trick: the pool's block axis is indexed by the
+            # PREFETCHED table, not the grid — block j of sequence b lives
+            # wherever the allocator put it.
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda b_, h_, j, bt, ln: (bt[b_, j], h_, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda b_, h_, j, bt, ln: (bt[b_, j], h_, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d),
+                               lambda b_, h_, j, bt, ln: (b_, h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_fwd_kernel, block_size=bs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        compiler_params=_CompilerParams(dimension_semantics=_DIMS),
+        interpret=interpret,
+    )(
+        block_table.astype(jnp.int32),
+        lengths.astype(jnp.int32),
+        q[:, :, None],               # [B, H, 1, D]
+        k_pool,
+        v_pool,
+    )
+    return out[:, :, 0]
+
+
+def paged_attention(
+    q: jnp.ndarray,            # [B, H, D]
+    k_pool: jnp.ndarray,       # [N, H, bs, D]
+    v_pool: jnp.ndarray,       # [N, H, bs, D]
+    block_table: jnp.ndarray,  # [B, M] int32
+    lengths: jnp.ndarray,      # [B] int32
+    *,
+    impl: str = "auto",
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Dispatch: "auto" = Pallas on TPU (no gather traffic), XLA elsewhere
+    (bit-exact vs the contiguous decode path — the serving tests' mode)."""
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"paged_attention impl={impl!r}: expected 'auto', 'xla' or 'pallas'"
+        )
+    if q.ndim != 3:
+        raise ValueError(f"q must be [B, H, D], got shape {q.shape}")
+    if k_pool.ndim != 4 or v_pool.shape != k_pool.shape:
+        raise ValueError(
+            f"k_pool/v_pool must be matching [N, H, bs, D], got "
+            f"{k_pool.shape} / {v_pool.shape}"
+        )
+    if impl == "auto":
+        impl = "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+    if impl == "pallas":
+        return paged_attention_pallas(
+            q, k_pool, v_pool, block_table, lengths, interpret=interpret
+        )
+    return paged_attention_xla(q, k_pool, v_pool, block_table, lengths)

@@ -17,6 +17,8 @@ code-specifies-model convention, SURVEY.md §5.6).
 
 Text prompts/continuations need tiktoken's GPT-2 BPE (network-gated on
 first fetch); ``--prompt_ids`` works fully offline and prints token ids.
+``--stream`` prints each token the moment the serving engine produces it
+(paged-KV decode; identical output to ``--decode_path cached`` per seed).
 """
 
 from __future__ import annotations
@@ -56,6 +58,12 @@ def build_argparser() -> argparse.ArgumentParser:
         "because this CLI always generates batch=1, below the measured "
         "cache-path crossover (scripts/bench_decode.py)",
     )
+    p.add_argument(
+        "--stream", action="store_true",
+        help="print tokens as they are generated, via the serving engine's "
+        "paged-KV decode (gpt_2_distributed_tpu/serving/); same tokens as "
+        "--decode_path cached for the same --seed",
+    )
     p.add_argument("--device", default=None,
                    help="jax platform override (cpu|tpu), like train.py --device")
     return p
@@ -87,7 +95,7 @@ def main(argv: list[str] | None = None) -> None:
         overrides["n_positions"] = args.seq_len
     config = MODEL_PRESETS[args.model].replace(**overrides)
 
-    path = args.ckpt
+    path = os.path.abspath(args.ckpt)  # orbax rejects relative paths
     if not os.path.exists(os.path.join(path, "meta.json")):
         latest = latest_checkpoint(path)
         if latest is None:
@@ -125,6 +133,39 @@ def main(argv: list[str] | None = None) -> None:
     params, meta = restore_params(path, template, shardings)
     print(f"checkpoint: {path} (step {meta.step}, "
           f"{meta.total_tokens:,} tokens trained)", file=sys.stderr)
+
+    if args.stream:
+        if args.decode_path == "reforward":
+            sys.exit("--stream decodes through the serving engine's paged KV "
+                     "path; drop --decode_path reforward")
+        from gpt_2_distributed_tpu.config import ServeConfig
+        from gpt_2_distributed_tpu.serving import ServingEngine
+
+        block_size = 16
+        need = -(-(len(ids) + args.new - 1) // block_size)
+        serve = ServeConfig(
+            max_batch=1, block_size=block_size, num_blocks=need + 1,
+        )
+        eng = ServingEngine(
+            params, config, serve,
+            temperature=args.temperature, top_k=args.top_k,
+        )
+        if enc is not None:
+            print(args.prompt, end="", flush=True)
+
+            def on_token(_req, tok):
+                print(enc.decode([tok]), end="", flush=True)
+        else:
+            print(",".join(str(t) for t in ids), end="", flush=True)
+
+            def on_token(_req, tok):
+                print(f",{tok}", end="", flush=True)
+
+        eng.submit(ids, args.new, rng=jax.random.PRNGKey(args.seed),
+                   on_token=on_token)
+        eng.run_until_idle()
+        print(flush=True)
+        return
 
     prompt = jnp.asarray([ids], jnp.int32)
     fn = generate_cached if args.decode_path == "cached" else generate

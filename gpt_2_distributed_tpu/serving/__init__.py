@@ -1,0 +1,21 @@
+"""Production serving subsystem: paged KV cache + continuous batching.
+
+The training stack ends at a one-shot decode loop (``models/decode.py``);
+this package is what turns it into something that serves *traffic*:
+
+* ``paged_cache`` — fixed-size KV blocks in one preallocated device buffer
+  plus a host-side free-list allocator and per-sequence block tables.
+* ``engine`` — the continuous-batching engine: prefill/decode phase split,
+  admission of queued requests into the in-flight decode batch at step
+  boundaries, eviction on EOS/max-len, streaming per-token output. The
+  decode step is ONE compiled program per ``ServeConfig`` signature.
+* ``serve`` — the CLI entry point (``gpt2-tpu-serve``).
+
+The paged attention op itself lives with the other kernels
+(``ops/paged_attention.py``).
+"""
+
+from gpt_2_distributed_tpu.serving.engine import RequestHandle, ServingEngine
+from gpt_2_distributed_tpu.serving.paged_cache import BlockAllocator
+
+__all__ = ["BlockAllocator", "RequestHandle", "ServingEngine"]

@@ -1,0 +1,473 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+The one-shot path (``models/decode.py::generate_cached``) compiles a whole
+(batch, prompt, total) signature and runs it to completion — fine for eval,
+wrong for traffic: every request shape recompiles, and a batch finishes at
+the speed of its longest member while finished rows burn flops. This engine
+is the serving-shaped alternative:
+
+* **Prefill/decode split per request.** Each admitted request runs its
+  prompt through ``decode.prefill`` once (jitted per prompt-length *bucket*
+  — lengths round up to a block multiple, so the compile-signature set is
+  small and bounded), samples its first token, and scatters its K/V into
+  pool blocks. From then on it only ever costs one row of the decode step.
+* **One decode step, compiled once.** The step's signature is fixed by
+  ``ServeConfig`` — ``[max_batch]`` token/position/key rows, the
+  ``[num_blocks, ...]`` pools, the ``[max_batch, M]`` block table — so
+  admissions and evictions are pure *data* changes. ``tests/test_serving.py``
+  asserts ``_cache_size() == 1`` across a full churn of arrivals and exits.
+* **Admission at step boundaries.** A FIFO queue feeds free slots; a request
+  is admitted only when the allocator can cover its *worst-case* block need
+  (``ceil((P + max_new - 1) / block_size)`` — the final sampled token is
+  emitted but never processed, so its position is never written), which
+  means an in-flight request can never OOM mid-decode. Head-of-line order
+  is preserved: if the head doesn't fit, nothing behind it jumps the queue.
+* **Eviction on EOS / max-len** releases the request's blocks and zeroes its
+  block-table row (back to the null block), leaving the slot free for the
+  next admission. Idle rows keep flowing through the compiled step with
+  ``length 0`` — the paged-attention mask makes them exact no-ops.
+* **Streaming**: every sampled token is pushed through the request's
+  ``on_token`` callback the step it is produced, including the
+  prefill-sampled first token (which is what TTFT measures).
+
+Exactness contract: with ``attn_impl="xla"`` on CPU, each request's token
+stream is bit-identical to ``generate_cached(batch=1, prompt, rng=request
+key)`` — greedy AND seeded sampling — for ANY interleaving of other
+requests. The decode step mirrors ``decode.decode_step`` op-for-op; rows
+are independent in every op (batch is a parallel dim throughout), and each
+slot carries its own PRNG chain in the exact split order of the one-shot
+scan. ``tests/test_serving.py`` enforces this.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import time
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gpt_2_distributed_tpu.config import GPT2Config, ServeConfig
+from gpt_2_distributed_tpu.models import decode, gpt2
+from gpt_2_distributed_tpu.models.generate import (
+    check_generation_args,
+    sample_token,
+)
+from gpt_2_distributed_tpu.ops.layers import layer_norm
+from gpt_2_distributed_tpu.ops.paged_attention import paged_attention
+from gpt_2_distributed_tpu.serving.paged_cache import (
+    BlockAllocator,
+    init_pools,
+    scatter_prefill,
+)
+
+
+class RequestHandle:
+    """One submitted request: its prompt, its growing output, and the
+    timestamps the bench reads (submit / first token / finish)."""
+
+    def __init__(
+        self,
+        rid: int,
+        prompt: list[int],
+        max_new_tokens: int,
+        on_token: Callable[["RequestHandle", int], None] | None = None,
+    ):
+        self.id = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.on_token = on_token
+        self.generated: list[int] = []
+        self.done = False
+        self.finish_reason: str | None = None  # "eos" | "length"
+        self.submit_time: float | None = None
+        self.first_token_time: float | None = None
+        self.finish_time: float | None = None
+        self._key = None        # [2] uint32 PRNG chain head
+        self._slot: int | None = None
+        self._blocks: list[int] | None = None
+
+    @property
+    def tokens(self) -> list[int]:
+        """Prompt + generated so far."""
+        return list(self.prompt) + list(self.generated)
+
+    def _emit(self, tok: int) -> None:
+        if self.first_token_time is None:
+            self.first_token_time = time.monotonic()
+        if self.on_token is not None:
+            self.on_token(self, tok)
+
+    def _finish(self, reason: str) -> None:
+        self.done = True
+        self.finish_reason = reason
+        self.finish_time = time.monotonic()
+
+
+def _prefill_impl(
+    params,
+    prompt: jnp.ndarray,   # [1, Pf] int32, right-padded to the bucket
+    p_real: jnp.ndarray,   # scalar int32 — true prompt length (traced!)
+    key: jnp.ndarray,      # [2] uint32
+    *,
+    config: GPT2Config,
+    pad_to: int,
+    temperature: float,
+    top_k: int | None,
+    compute_dtype,
+):
+    """Prompt forward + first-token sample for one request.
+
+    Compiles once per (Pf, pad_to) bucket, NOT per prompt length: the true
+    length arrives as a traced scalar and only feeds a dynamic_slice. The
+    right-padding is causally inert — K/V and hidden states at positions
+    < p_real are bit-identical to an unpadded run (padded columns are
+    masked out of every softmax row we read; see tests/test_serving.py).
+
+    Returns (first_token scalar, advanced key, k, v ``[L, H, pad_to, D]``)
+    with the PRNG split order of ``generate_cached``: split once, sample
+    with the sub, carry the main — so a request's whole chain matches the
+    one-shot path's.
+    """
+    h, cache = decode.prefill(
+        params, config, prompt, prompt.shape[1], compute_dtype
+    )
+    h_last = jax.lax.dynamic_slice_in_dim(h, p_real - 1, 1, axis=1)[:, 0]
+    logits0 = jnp.einsum(
+        "bc,vc->bv", h_last, params["wte"].astype(h_last.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    key, sub = jax.random.split(key)
+    first = sample_token(logits0, sub, temperature, top_k)[0]
+    k, v = cache.k[:, 0], cache.v[:, 0]   # [L, H, Pf, D]
+    if pad_to > k.shape[2]:
+        # The last block straddles n_positions: the forward can't run past
+        # the position table, but the scatter writes whole blocks. Zero-pad
+        # — the tail is overwritten by decode before it's ever attendable.
+        pad = ((0, 0), (0, 0), (0, pad_to - k.shape[2]), (0, 0))
+        k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+    return first, key, k, v
+
+
+def _decode_step_impl(
+    params,
+    k_pool: jnp.ndarray,       # [L, N, H, bs, D]
+    v_pool: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, M] int32
+    tokens: jnp.ndarray,       # [B] int32 — token to process, at `pos`
+    pos: jnp.ndarray,          # [B] int32
+    active: jnp.ndarray,       # [B] bool
+    keys: jnp.ndarray,         # [B, 2] uint32 per-slot PRNG chains
+    *,
+    config: GPT2Config,
+    temperature: float,
+    top_k: int | None,
+    attn_impl: str,
+):
+    """One continuous-batching decode step: write each active row's K/V at
+    its own position, attend over its paged prefix, sample its next token.
+
+    Mirrors ``decode.decode_step`` op-for-op (same embedding gathers, same
+    einsum forms, per-position sublayers) with two generalizations: `pos`
+    is per-row instead of a shared scalar, and the cache indexing goes
+    through the block table. Inactive rows are steered to the null block
+    and a zero attention length — their lanes compute garbage that nothing
+    reads.
+    """
+    bsz = tokens.shape[0]
+    dtype = k_pool.dtype
+    bs = k_pool.shape[3]
+    c = config.n_embd
+
+    tok = params["wte"].astype(dtype).at[tokens].get(mode="clip")
+    wpe = params["wpe"].astype(dtype).at[pos].get(mode="clip")   # [B, C]
+    x = (tok + wpe)[:, None]                                     # [B, 1, C]
+
+    lengths = jnp.where(active, pos + 1, 0).astype(jnp.int32)
+    blk = block_table[jnp.arange(bsz), pos // bs]
+    blk = jnp.where(active, blk, 0)   # idle rows scribble on the null block
+    off = pos % bs
+
+    def body(x, layer):
+        bp, kp, vp = layer            # kp/vp: [N, H, bs, D]
+        y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
+        q, k, v = gpt2.qkv_proj(config, y, bp)                   # [B, 1, H, D]
+        kp = kp.at[blk, :, off].set(k[:, 0])
+        vp = vp.at[blk, :, off].set(v[:, 0])
+        o = paged_attention(
+            q[:, 0], kp, vp, block_table, lengths, impl=attn_impl
+        )                                                        # [B, H, D]
+        o = o.reshape(bsz, 1, c)
+        o = o @ bp["attn_proj_w"].astype(x.dtype) + bp["attn_proj_b"].astype(x.dtype)
+        x = x + o
+        x = gpt2._mlp_sublayer(config, x, bp, None, True)
+        return x, (kp, vp)
+
+    x, (kps, vps) = jax.lax.scan(body, x, (params["block"], k_pool, v_pool))
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
+    logits = jnp.einsum(
+        "btc,vc->btv", x, params["wte"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )[:, 0]                                                      # [B, V] fp32
+
+    # Per-row PRNG chains: each slot samples with ITS key on a [1, V] row —
+    # the threefry bits are identical to a batch-1 generate_cached step, so
+    # a request's tokens don't depend on who shares the batch with it.
+    def row_sample(logits_row, key):
+        key, sub = jax.random.split(key)
+        tok = sample_token(logits_row[None], sub, temperature, top_k)[0]
+        return tok, key
+
+    next_tokens, keys = jax.vmap(row_sample)(logits, keys)
+    return next_tokens.astype(jnp.int32), keys, kps, vps
+
+
+class ServingEngine:
+    """Continuous-batching serving engine. See the module docstring.
+
+    Typical loop::
+
+        eng = ServingEngine(params, config, ServeConfig(max_batch=8))
+        h = eng.submit(prompt_ids, max_new_tokens=64, rng=0,
+                       on_token=lambda req, t: print(t))
+        eng.run_until_idle()
+        print(h.generated)
+    """
+
+    def __init__(
+        self,
+        params,
+        config: GPT2Config,
+        serve: ServeConfig | None = None,
+        *,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        compute_dtype=jnp.bfloat16,
+    ):
+        serve = serve if serve is not None else ServeConfig()
+        # Sampling params are engine-level (static in the compiled step);
+        # validate top_k once here with the shared check so a bad engine
+        # config fails like a bad request would.
+        check_generation_args(config, 1, 1, top_k, batch=serve.max_batch)
+        self.params = params
+        self.config = config
+        self.serve = serve
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.compute_dtype = compute_dtype
+
+        m = serve.max_blocks_per_seq(config.n_positions)
+        self.k_pool, self.v_pool = init_pools(config, serve, compute_dtype)
+        self.allocator = BlockAllocator(serve.num_blocks)
+        # Scheduler state lives on the HOST as numpy: admission/eviction
+        # mutate it in place for free, and the arrays ship to the compiled
+        # step with each call (a few hundred bytes). jnp `.at[].set` outside
+        # jit costs ~1-2 ms PER UPDATE in op-by-op dispatch — doing the
+        # bookkeeping device-side made admission 6x slower than the prefill
+        # it wraps.
+        self.block_table = np.zeros((serve.max_batch, m), np.int32)
+        self.pos = np.zeros((serve.max_batch,), np.int32)
+        self.tokens = np.zeros((serve.max_batch,), np.int32)
+        self.active = np.zeros((serve.max_batch,), bool)
+        self.keys = np.zeros((serve.max_batch, 2), np.uint32)
+
+        self._slots: list[RequestHandle | None] = [None] * serve.max_batch
+        self._queue: collections.deque[RequestHandle] = collections.deque()
+        self._next_id = 0
+        self.stats = {
+            "admitted": 0, "finished": 0, "prefills": 0,
+            "decode_steps": 0, "tokens_out": 0,
+        }
+
+        # Per-engine jits so tests can count THIS engine's compilations:
+        # the no-retrace contract is `_decode_fn._cache_size() == 1` across
+        # arbitrary admission/eviction churn.
+        self._decode_fn = jax.jit(
+            functools.partial(
+                _decode_step_impl, config=config,
+                temperature=self.temperature, top_k=top_k,
+                attn_impl=serve.attn_impl,
+            ),
+            donate_argnames=("k_pool", "v_pool"),
+        )
+        self._prefill_fn = jax.jit(
+            functools.partial(
+                _prefill_impl, config=config,
+                temperature=self.temperature, top_k=top_k,
+                compute_dtype=compute_dtype,
+            ),
+            static_argnames=("pad_to",),
+        )
+
+    # ------------------------------------------------------------- intake
+
+    def _blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        # Positions 0 .. P+max_new-2 get written (the last sampled token is
+        # emitted but never processed); worst case ignores early EOS.
+        return -(-(prompt_len + max_new_tokens - 1) // self.serve.block_size)
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        *,
+        rng: jax.Array | int = 0,
+        on_token: Callable[[RequestHandle, int], None] | None = None,
+    ) -> RequestHandle:
+        """Queue a request. Validation happens HERE (the admission gate),
+        with the same ``check_generation_args`` ValueErrors as both decode
+        paths — a request the one-shot sampler would reject never enqueues.
+        """
+        prompt = [int(t) for t in prompt]
+        check_generation_args(
+            self.config, len(prompt), max_new_tokens, self.top_k, batch=1
+        )
+        need = self._blocks_needed(len(prompt), max_new_tokens)
+        if need > self.serve.num_blocks - 1:
+            raise ValueError(
+                f"request needs {need} KV blocks but the pool only has "
+                f"{self.serve.num_blocks - 1} allocatable (num_blocks="
+                f"{self.serve.num_blocks}, block_size={self.serve.block_size})"
+                f" — it could never be admitted"
+            )
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        req = RequestHandle(self._next_id, prompt, max_new_tokens, on_token)
+        self._next_id += 1
+        req._key = np.asarray(rng, np.uint32)
+        req.submit_time = time.monotonic()
+        self._queue.append(req)
+        return req
+
+    def _try_admit(self) -> int:
+        """Admit queued requests into free slots, FIFO, while blocks last."""
+        admitted = 0
+        bs = self.serve.block_size
+        while self._queue:
+            slot = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if slot is None:
+                break
+            req = self._queue[0]
+            p = len(req.prompt)
+            need = self._blocks_needed(p, req.max_new_tokens)
+            ids = self.allocator.alloc(need)
+            if ids is None:
+                break   # head waits for evictions; nothing jumps the queue
+            self._queue.popleft()
+            self.stats["admitted"] += 1
+
+            nb = -(-p // bs)                       # blocks prefill fills
+            pb = nb * bs                           # scatter width
+            pf = min(pb, self.config.n_positions)  # forward width
+            prompt_arr = np.zeros((1, pf), np.int32)
+            prompt_arr[0, :p] = req.prompt
+            first, key, k, v = self._prefill_fn(
+                self.params, prompt_arr, np.int32(p), req._key, pad_to=pb,
+            )
+            self.stats["prefills"] += 1
+            first_i = int(first)
+            req.generated.append(first_i)
+            self.stats["tokens_out"] += 1
+            req._emit(first_i)
+
+            if self.serve.eos_id is not None and first_i == self.serve.eos_id:
+                req._finish("eos")
+            elif req.max_new_tokens == 1:
+                req._finish("length")
+            if req.done:
+                # Finished at prefill: blocks go straight back, the slot
+                # was never occupied, the scatter is skipped.
+                self.allocator.release(ids)
+                self.stats["finished"] += 1
+                continue
+
+            self.k_pool, self.v_pool = scatter_prefill(
+                self.k_pool, self.v_pool, k, v,
+                np.asarray(ids[:nb], np.int32),
+            )
+            req._slot, req._blocks = slot, ids
+            self._slots[slot] = req
+            self.block_table[slot, :] = 0
+            self.block_table[slot, :need] = ids
+            self.pos[slot] = p
+            self.tokens[slot] = first_i
+            self.active[slot] = True
+            self.keys[slot] = np.asarray(key)
+            admitted += 1
+        return admitted
+
+    # -------------------------------------------------------------- churn
+
+    def _evict(self, slot: int, reason: str) -> None:
+        req = self._slots[slot]
+        req._finish(reason)
+        self.allocator.release(req._blocks)
+        req._slot, req._blocks = None, None
+        self._slots[slot] = None
+        # Table row back to the null block; the slot decodes as a no-op
+        # (length 0) until the next admission overwrites it.
+        self.block_table[slot, :] = 0
+        self.pos[slot] = 0
+        self.active[slot] = False
+        self.stats["finished"] += 1
+
+    def _has_active(self) -> bool:
+        return any(s is not None for s in self._slots)
+
+    def step(self) -> int:
+        """One engine step: admit what fits, then one compiled decode step
+        for the whole batch. Returns tokens emitted (0 = nothing in
+        flight)."""
+        self._try_admit()
+        if not self._has_active():
+            return 0
+
+        was_active = self.active.copy()
+        next_tokens, new_keys, self.k_pool, self.v_pool = self._decode_fn(
+            self.params, self.k_pool, self.v_pool, self.block_table,
+            self.tokens, self.pos, self.active, self.keys,
+        )
+        self.stats["decode_steps"] += 1
+        toks_host = np.asarray(next_tokens)
+        self.keys = np.array(new_keys)  # writable copy: admission writes rows
+        # Advance every row that decoded this step; evictions below then
+        # reset their rows.
+        self.tokens = np.where(was_active, toks_host, self.tokens)
+        self.pos = np.where(was_active, self.pos + 1, self.pos)
+        emitted = 0
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            t = int(toks_host[slot])
+            req.generated.append(t)
+            emitted += 1
+            req._emit(t)
+            if self.serve.eos_id is not None and t == self.serve.eos_id:
+                self._evict(slot, "eos")
+            elif len(req.generated) >= req.max_new_tokens:
+                self._evict(slot, "length")
+        self.stats["tokens_out"] += emitted
+        return emitted
+
+    def run_until_idle(self, max_steps: int | None = None) -> int:
+        """Drive ``step`` until the queue and every slot drain. Returns
+        total tokens emitted. ``submit``'s block-need check guarantees the
+        queue head can always be admitted once the engine is empty, so this
+        terminates."""
+        total = 0
+        steps = 0
+        while self._queue or self._has_active():
+            total += self.step()
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(
+                    f"run_until_idle: exceeded max_steps={max_steps} with "
+                    f"{len(self._queue)} queued / "
+                    f"{sum(s is not None for s in self._slots)} in flight"
+                )
+        return total

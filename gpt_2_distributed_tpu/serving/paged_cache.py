@@ -1,0 +1,126 @@
+"""Paged KV-cache plumbing: the block pool, its allocator, and the device
+scatter that moves prefill K/V into pool blocks.
+
+Layout: one preallocated buffer per K and V, ``[L, num_blocks, H,
+block_size, D]`` — layer-stacked to mirror the parameter pytree (so the
+decode step scans layers exactly like training does), block-paged on the
+second axis so sequences of different lengths share the buffer through
+per-sequence block tables instead of per-shape contiguous allocations.
+
+Block 0 is the null block: never allocated, it backs idle slots and the
+padded tail of every block table, so device code can index the table
+unconditionally — out-of-range entries fetch garbage that the per-sequence
+length mask then drops (``ops/paged_attention.py``).
+
+The allocator is host-side and deliberately dumb: a free list with O(1)
+alloc/release and loud failure on double-free/foreign ids. All policy
+(when to admit, how many blocks a request needs) lives in the engine.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from gpt_2_distributed_tpu.config import GPT2Config, ServeConfig
+
+
+class BlockAllocator:
+    """Free-list allocator over pool blocks ``1..num_blocks-1`` (0 = null).
+
+    ``alloc`` is all-or-nothing: a request either gets every block its
+    worst-case length needs at admission, or stays queued — an admitted
+    sequence can never hit a mid-decode out-of-memory (the simple
+    no-preemption admission policy; vLLM-style swapping/recompute is the
+    obvious extension if traces demand it).
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks={num_blocks} must be >= 2 (block 0 is reserved)"
+            )
+        self.num_blocks = num_blocks
+        self._free: collections.deque[int] = collections.deque(
+            range(1, num_blocks)
+        )
+        self._held: set[int] = set()
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """n blocks, or None (leaving the free list untouched) if the pool
+        can't currently cover them."""
+        if n < 1:
+            raise ValueError(f"alloc({n}): need at least one block")
+        if n > len(self._free):
+            return None
+        ids = [self._free.popleft() for _ in range(n)]
+        self._held.update(ids)
+        return ids
+
+    def release(self, ids: Iterable[int]) -> None:
+        for i in ids:
+            if i not in self._held:
+                raise ValueError(
+                    f"release({i}): not an allocated block (double free, the "
+                    f"null block, or a foreign id)"
+                )
+            self._held.discard(i)
+            self._free.append(i)
+
+
+def init_pools(
+    config: GPT2Config,
+    serve: ServeConfig,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The preallocated K and V pools, ``[L, N, H, bs, D]`` zeros."""
+    shape = (
+        config.n_layer,
+        serve.num_blocks,
+        config.n_head,
+        serve.block_size,
+        config.head_dim,
+    )
+    return jnp.zeros(shape, compute_dtype), jnp.zeros(shape, compute_dtype)
+
+
+def pool_bytes(config: GPT2Config, serve: ServeConfig, itemsize: int = 2) -> int:
+    """Device bytes the two pools pin (the serving deployment's KV budget)."""
+    return (
+        2 * config.n_layer * serve.num_blocks * config.n_head
+        * serve.block_size * config.head_dim * itemsize
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def scatter_prefill(
+    k_pool: jnp.ndarray,   # [L, N, H, bs, D]
+    v_pool: jnp.ndarray,
+    k: jnp.ndarray,        # [L, H, Ppad, D] — prefill K, Ppad = nb * bs
+    v: jnp.ndarray,
+    block_ids: jnp.ndarray,  # [nb] int32 pool destinations
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter one sequence's prefill K/V into its allocated pool blocks.
+
+    Compiles once per (Ppad, nb) bucket — the engine rounds prompt lengths
+    up to block multiples precisely so this signature set stays small. The
+    pools are donated: admission rewrites them in place rather than holding
+    two copies of the serving deployment's largest buffer.
+    """
+    l, h, ppad, d = k.shape
+    bs = k_pool.shape[3]
+    nb = ppad // bs
+    kb = k.reshape(l, h, nb, bs, d).transpose(0, 2, 1, 3, 4)
+    vb = v.reshape(l, h, nb, bs, d).transpose(0, 2, 1, 3, 4)
+    return (
+        k_pool.at[:, block_ids].set(kb.astype(k_pool.dtype)),
+        v_pool.at[:, block_ids].set(vb.astype(v_pool.dtype)),
+    )

@@ -1,0 +1,190 @@
+"""Serving CLI: run a batch of requests through the continuous-batching
+engine, streaming results as JSON lines.
+
+Offline-first by design (no server socket — the engine is the product;
+wrapping it in a transport is deployment-specific): requests come from a
+JSONL file or stdin, one object per line::
+
+    {"prompt_ids": [464, 3616], "new": 64, "seed": 7}
+    {"prompt": "The meaning of life", "new": 32}
+
+``prompt`` needs tiktoken's GPT-2 BPE (network-gated); ``prompt_ids`` works
+fully offline. Per-line fields default to --new / --seed. Output is JSONL
+on stdout: with ``--stream`` a ``{"id", "token"}`` line per token as it is
+produced, and always a final ``{"id", ..., "generated", "ttft_ms",
+"finish_reason"}`` record per request. All requests are in flight together
+up to ``--max_batch`` — submission order is admission order (FIFO), but
+completions interleave.
+
+Usage::
+
+    gpt2-tpu-serve --ckpt runs/ckpt --requests reqs.jsonl --stream
+    echo '{"prompt_ids": [1,2,3], "new": 8}' | gpt2-tpu-serve \
+        --ckpt runs/ckpt --requests -
+
+``--init_random`` swaps the checkpoint for seeded-init weights (smoke tests
+and benchmarking the serving path without training first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--ckpt", default=None,
+                   help="checkpoint dir (step_NNNNNNN) or save dir (latest)")
+    p.add_argument("--init_random", action="store_true",
+                   help="serve seeded-init weights instead of a checkpoint")
+    p.add_argument("--model", default="124M", choices=sorted(MODEL_PRESETS))
+    p.add_argument("--n_layer", type=int, default=None)
+    p.add_argument("--n_embd", type=int, default=None)
+    p.add_argument("--n_head", type=int, default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--seq_len", type=int, default=None)
+    p.add_argument("--requests", required=True,
+                   help="JSONL request file, or '-' for stdin")
+    p.add_argument("--new", type=int, default=64,
+                   help="default max_new_tokens for lines without 'new'")
+    p.add_argument("--seed", type=int, default=0,
+                   help="default sampling seed for lines without 'seed'")
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top_k", type=int, default=None)
+    p.add_argument("--eos", type=int, default=None,
+                   help="token id that finishes a request early")
+    p.add_argument("--max_batch", type=int, default=8)
+    p.add_argument("--block_size", type=int, default=16)
+    p.add_argument("--num_blocks", type=int, default=0,
+                   help="KV pool blocks; 0 = max_batch worst-case sequences")
+    p.add_argument("--attn_impl", default="auto",
+                   choices=["auto", "xla", "pallas"])
+    p.add_argument("--stream", action="store_true",
+                   help="emit a JSON line per token as it is generated")
+    p.add_argument("--device", default=None,
+                   help="jax platform override (cpu|tpu)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = build_argparser()
+    args = p.parse_args(argv)
+    if (args.ckpt is None) == (not args.init_random):
+        p.error("exactly one of --ckpt / --init_random is required")
+    if args.device:
+        os.environ["JAX_PLATFORMS"] = args.device
+
+    import jax
+
+    from gpt_2_distributed_tpu.checkpoint import latest_checkpoint, restore_params
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS, ServeConfig
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.serving import ServingEngine
+
+    overrides = {
+        k: getattr(args, k)
+        for k in ("n_layer", "n_embd", "n_head", "vocab_size")
+        if getattr(args, k) is not None
+    }
+    if args.seq_len is not None:
+        overrides["n_positions"] = args.seq_len
+    config = MODEL_PRESETS[args.model].replace(**overrides)
+
+    if args.init_random:
+        params = gpt2.init_params(config)
+    else:
+        path = os.path.abspath(args.ckpt)  # orbax rejects relative paths
+        if not os.path.exists(os.path.join(path, "meta.json")):
+            latest = latest_checkpoint(path)
+            if latest is None:
+                sys.exit(f"no checkpoint found under {path!r}")
+            path = latest
+        template = jax.eval_shape(lambda: gpt2.init_params(config))
+        one_device = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        shardings = jax.tree_util.tree_map(lambda _: one_device, template)
+        params, meta = restore_params(path, template, shardings)
+        print(f"checkpoint: {path} (step {meta.step})", file=sys.stderr)
+
+    lines = (sys.stdin if args.requests == "-"
+             else open(args.requests, encoding="utf-8"))
+    specs = []
+    enc = None
+    with lines:
+        for ln, line in enumerate(lines, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                sys.exit(f"--requests line {ln}: bad JSON ({e})")
+            if ("prompt_ids" in obj) == ("prompt" in obj):
+                sys.exit(f"--requests line {ln}: exactly one of "
+                         f"'prompt_ids' / 'prompt' is required")
+            if "prompt" in obj:
+                if enc is None:
+                    try:
+                        import tiktoken
+                        enc = tiktoken.get_encoding("gpt2")
+                    except Exception as e:  # noqa: BLE001 — network-gated
+                        sys.exit(f"'prompt' needs tiktoken's GPT-2 BPE ({e});"
+                                 " use 'prompt_ids' offline")
+                ids = enc.encode_ordinary(obj["prompt"])
+            else:
+                ids = [int(t) for t in obj["prompt_ids"]]
+            specs.append((ids, int(obj.get("new", args.new)),
+                          int(obj.get("seed", args.seed))))
+    if not specs:
+        sys.exit("--requests: no requests")
+
+    num_blocks = args.num_blocks
+    probe = ServeConfig(max_batch=args.max_batch, block_size=args.block_size)
+    if num_blocks == 0:
+        num_blocks = 1 + args.max_batch * probe.max_blocks_per_seq(
+            config.n_positions
+        )
+    serve = ServeConfig(
+        max_batch=args.max_batch, block_size=args.block_size,
+        num_blocks=num_blocks, attn_impl=args.attn_impl, eos_id=args.eos,
+    )
+    eng = ServingEngine(params, config, serve,
+                        temperature=args.temperature, top_k=args.top_k)
+
+    def on_token(req, tok):
+        if args.stream:
+            print(json.dumps({"id": req.id, "token": tok}), flush=True)
+
+    t0 = time.monotonic()
+    handles = []
+    for ids, new, seed in specs:
+        # ValueError here (prompt too long, new<1, ...) is a bad REQUEST:
+        # report and fail loudly rather than serving the rest silently.
+        try:
+            handles.append(eng.submit(ids, new, rng=seed, on_token=on_token))
+        except ValueError as e:
+            sys.exit(f"request {len(handles)}: {e}")
+    eng.run_until_idle()
+    wall = time.monotonic() - t0
+
+    for h in handles:
+        print(json.dumps({
+            "id": h.id,
+            "generated": h.generated,
+            "text": enc.decode(h.generated) if enc is not None else None,
+            "finish_reason": h.finish_reason,
+            "ttft_ms": round((h.first_token_time - h.submit_time) * 1e3, 2),
+        }), flush=True)
+    toks = sum(len(h.generated) for h in handles)
+    print(f"{len(handles)} requests, {toks} tokens, {wall:.3f}s "
+          f"({toks / wall:.0f} tok/s), {eng.stats['decode_steps']} decode "
+          f"steps", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

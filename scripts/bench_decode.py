@@ -7,7 +7,7 @@ step). Each generate call is ONE jit dispatch (the whole decode loop is a
 ``lax.scan`` inside the jit), so tunnel round-trips are paid once per call,
 not per token — the same pipelined-measurement rule as bench.py.
 
-Usage: PYTHONPATH=. python scripts/bench_decode.py [--model 124M]
+Usage: python scripts/bench_decode.py [--model 124M]
        [--batch 8] [--prompt 128] [--new 256]
 
 Recorded (124M, TPU v5 lite, 2026-07-30):
@@ -25,9 +25,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 
 def main() -> None:
@@ -42,6 +48,18 @@ def main() -> None:
         help="only bench the cached path (the re-forward baseline is slow "
         "at large --new)",
     )
+    p.add_argument(
+        "--json", default=None, metavar="PATH",
+        help="also write the result dict to PATH (same record discipline "
+        "as scripts/bench_fused.py -> BENCH_FUSED.json)",
+    )
+    # Tiny-model overrides so CI can exercise the full CLI on CPU without
+    # paying for a preset-sized model (mirrors train.py/sample.py).
+    p.add_argument("--n_layer", type=int, default=None)
+    p.add_argument("--n_embd", type=int, default=None)
+    p.add_argument("--n_head", type=int, default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--seq_len", type=int, default=None)
     args = p.parse_args()
 
     import jax
@@ -52,7 +70,14 @@ def main() -> None:
     from gpt_2_distributed_tpu.models.decode import generate_cached
     from gpt_2_distributed_tpu.models.generate import generate
 
-    config = MODEL_PRESETS[args.model]
+    overrides = {
+        k: getattr(args, k)
+        for k in ("n_layer", "n_embd", "n_head", "vocab_size")
+        if getattr(args, k) is not None
+    }
+    if args.seq_len is not None:
+        overrides["n_positions"] = args.seq_len
+    config = MODEL_PRESETS[args.model].replace(**overrides)
     params = gpt2.init_params(config)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
@@ -94,6 +119,10 @@ def main() -> None:
         results["reforward_tok_s"] = round(args.batch * args.new / dt_r, 1)
         results["speedup"] = round(dt_r / dt_c, 2)
 
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+            f.write("\n")
     print(json.dumps(results))
 
 

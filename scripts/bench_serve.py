@@ -1,0 +1,284 @@
+"""Serving bench: continuous batching vs one-shot decode on a Poisson trace.
+
+Drives ``gpt_2_distributed_tpu/serving/`` with a SEEDED offline request
+trace — Poisson arrivals, uniform prompt/new-token lengths — and reports
+the numbers a serving deployment is judged on:
+
+* **tok/s and tok/s/chip** — generated-token throughput over the trace.
+* **TTFT p50/p99** — time from a request's *arrival* (not its admission) to
+  its first streamed token, so queueing delay is counted honestly.
+* **Inter-token latency p50/p99** — gaps between consecutive streamed
+  tokens, pooled across all requests.
+
+The same trace then runs through the one-shot path — sequential
+``generate_cached`` calls, batch 1 per request, each distinct
+(prompt, new) shape compile-warmed beforehand — which is what serving this
+repo meant before the engine existed. Continuous batching wins by keeping
+``max_batch`` rows in one compiled decode step while the one-shot path
+gives each request the whole machine serially. The comparison is
+intentionally charitable to the baseline: its compiles are excluded, the
+engine's queueing gaps are not.
+
+Results go to stdout AND ``--json`` (default ``BENCH_SERVE.json``) — the
+same record discipline as scripts/bench_fused.py.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --model 124M \
+        --n_layer 2 --n_embd 64 --n_head 2 --vocab_size 257 --seq_len 128
+
+Recorded (tiny 2-layer config above, CPU, 2026-08-05 — BENCH_SERVE.json):
+  engine 4878 tok/s at occupancy 7.15/8 vs one-shot 2364 tok/s (2.06x);
+  TTFT p50 48.7 ms under the saturating default trace, 2.2 ms at --rate 100.
+The CPU win comes purely from batching fixed per-op overhead; on TPU the
+same structure amortizes weight reads across rows, which is the real prize.
+
+Flag combos the bench can't honor are refused at parse time (mirroring
+bench.py's --suite rejection): ``--baseline_only`` contradicts
+``--no_baseline``, and neither makes sense with ``--requests 0``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="124M")
+    p.add_argument("--n_layer", type=int, default=None)
+    p.add_argument("--n_embd", type=int, default=None)
+    p.add_argument("--n_head", type=int, default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--seq_len", type=int, default=None,
+                   help="n_positions override (bounds prompt+new)")
+    # Trace shape. The default rate saturates the engine (queue builds up,
+    # occupancy ~max_batch) so the throughput number is a capacity figure;
+    # drop --rate to ~the engine's req/s to measure TTFT under light load.
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=1000.0,
+                   help="Poisson arrival rate, requests/s")
+    p.add_argument("--trace_seed", type=int, default=0)
+    p.add_argument("--prompt_min", type=int, default=4)
+    p.add_argument("--prompt_max", type=int, default=24)
+    p.add_argument("--new_min", type=int, default=16)
+    p.add_argument("--new_max", type=int, default=48)
+    # Engine shape.
+    p.add_argument("--max_batch", type=int, default=8)
+    p.add_argument("--block_size", type=int, default=16)
+    p.add_argument("--num_blocks", type=int, default=0,
+                   help="KV pool blocks; 0 = enough for max_batch worst-case "
+                   "sequences")
+    p.add_argument("--attn_impl", default="auto",
+                   choices=["auto", "xla", "pallas"])
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top_k", type=int, default=None)
+    p.add_argument("--no_baseline", action="store_true",
+                   help="skip the one-shot generate_cached comparison")
+    p.add_argument("--baseline_only", action="store_true",
+                   help="run only the one-shot comparison (engine debug)")
+    p.add_argument("--json", default="BENCH_SERVE.json", metavar="PATH",
+                   help="result file ('' disables the write)")
+    return p
+
+
+def validate_args(p: argparse.ArgumentParser, args: argparse.Namespace) -> None:
+    """Parse-time refusals for combos the bench can't honor — before any
+    jax import, like bench.py's --suite rejection."""
+    if args.baseline_only and args.no_baseline:
+        p.error("--baseline_only contradicts --no_baseline; pick one")
+    if args.requests < 1:
+        p.error(f"--requests {args.requests}: a trace needs at least one "
+                "request")
+    if args.rate <= 0:
+        p.error(f"--rate {args.rate}: arrival rate must be positive")
+    if args.prompt_min < 1 or args.prompt_min > args.prompt_max:
+        p.error("--prompt_min/--prompt_max must satisfy 1 <= min <= max")
+    if args.new_min < 1 or args.new_min > args.new_max:
+        p.error("--new_min/--new_max must satisfy 1 <= min <= max")
+
+
+def percentiles(xs, np):
+    if not xs:
+        return None, None
+    return (round(float(np.percentile(xs, 50)) * 1e3, 2),
+            round(float(np.percentile(xs, 99)) * 1e3, 2))
+
+
+def main(argv=None) -> None:
+    p = build_argparser()
+    args = p.parse_args(argv)
+    validate_args(p, args)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS, ServeConfig
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.models.decode import generate_cached
+    from gpt_2_distributed_tpu.serving import ServingEngine
+
+    overrides = {
+        k: getattr(args, k)
+        for k in ("n_layer", "n_embd", "n_head", "vocab_size")
+        if getattr(args, k) is not None
+    }
+    if args.seq_len is not None:
+        overrides["n_positions"] = args.seq_len
+    config = MODEL_PRESETS[args.model].replace(**overrides)
+    if args.prompt_max + args.new_max > config.n_positions:
+        p.error(
+            f"--prompt_max {args.prompt_max} + --new_max {args.new_max} "
+            f"exceeds n_positions {config.n_positions}; shrink the trace or "
+            f"raise --seq_len"
+        )
+
+    num_blocks = args.num_blocks
+    serve_probe = ServeConfig(max_batch=args.max_batch,
+                              block_size=args.block_size)
+    if num_blocks == 0:
+        num_blocks = 1 + args.max_batch * serve_probe.max_blocks_per_seq(
+            config.n_positions
+        )
+    serve = ServeConfig(
+        max_batch=args.max_batch, block_size=args.block_size,
+        num_blocks=num_blocks, attn_impl=args.attn_impl,
+    )
+
+    params = gpt2.init_params(config)
+
+    # ---- the seeded trace --------------------------------------------------
+    rng = np.random.default_rng(args.trace_seed)
+    n = args.requests
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate, n))
+    plens = rng.integers(args.prompt_min, args.prompt_max + 1, n)
+    news = rng.integers(args.new_min, args.new_max + 1, n)
+    prompts = [rng.integers(0, config.vocab_size, int(pl)).tolist()
+               for pl in plens]
+    keys = [jax.random.PRNGKey(args.trace_seed * 100_000 + i)
+            for i in range(n)]
+    total_new = int(news.sum())
+
+    result = {
+        "bench": "serve",
+        "device": jax.devices()[0].device_kind,
+        "n_devices": jax.device_count(),
+        "model": {"preset": args.model, **overrides},
+        "serve": {"max_batch": serve.max_batch,
+                  "block_size": serve.block_size,
+                  "num_blocks": serve.num_blocks,
+                  "attn_impl": serve.attn_impl},
+        "trace": {"requests": n, "rate_req_s": args.rate,
+                  "seed": args.trace_seed,
+                  "prompt_len": [args.prompt_min, args.prompt_max],
+                  "new_tokens": [args.new_min, args.new_max],
+                  "total_new_tokens": total_new},
+        "temperature": args.temperature,
+        "top_k": args.top_k,
+    }
+
+    # ---- continuous batching ----------------------------------------------
+    if not args.baseline_only:
+        eng = ServingEngine(
+            params, config, serve,
+            temperature=args.temperature, top_k=args.top_k,
+        )
+        # Warm every compile the trace will hit (one prefill bucket per
+        # distinct block count, plus the decode step), then reset stats.
+        for nb in sorted({-(-int(pl) // serve.block_size) for pl in plens}):
+            pl = min(nb * serve.block_size, config.n_positions - 2)
+            eng.submit([1] * pl, 2, rng=0)
+        eng.run_until_idle()
+        eng.stats = {k: 0 for k in eng.stats}
+
+        token_times: dict[int, list[float]] = {}
+
+        def on_token(req, _tok, _tt=token_times):
+            _tt.setdefault(req.id, []).append(time.monotonic())
+
+        t0 = time.monotonic()
+        handles = []
+        nxt = 0
+        while nxt < n or eng._queue or eng._has_active():
+            now = time.monotonic() - t0
+            while nxt < n and arrivals[nxt] <= now:
+                handles.append(eng.submit(
+                    prompts[nxt], int(news[nxt]), rng=keys[nxt],
+                    on_token=on_token,
+                ))
+                nxt += 1
+            if eng.step() == 0 and nxt < n:
+                time.sleep(min(0.001, max(0.0, arrivals[nxt] - now)))
+        wall = time.monotonic() - t0
+
+        assert all(h.done for h in handles)
+        emitted = sum(len(h.generated) for h in handles)
+        assert emitted == total_new  # no EOS in the trace: all run to max_new
+        ttfts = [h.first_token_time - (t0 + arrivals[i])
+                 for i, h in enumerate(handles)]
+        itls = [dt for ts in token_times.values()
+                for dt in np.diff(ts).tolist()]
+        ttft_p50, ttft_p99 = percentiles(ttfts, np)
+        itl_p50, itl_p99 = percentiles(itls, np)
+        steps = max(eng.stats["decode_steps"], 1)
+        result["engine"] = {
+            "wall_s": round(wall, 4),
+            "tok_s": round(emitted / wall, 1),
+            "tok_s_per_chip": round(emitted / wall / jax.device_count(), 1),
+            "ttft_p50_ms": ttft_p50, "ttft_p99_ms": ttft_p99,
+            "itl_p50_ms": itl_p50, "itl_p99_ms": itl_p99,
+            "decode_steps": eng.stats["decode_steps"],
+            "mean_batch_occupancy": round(
+                (emitted - len(handles)) / steps, 2
+            ),
+        }
+
+    # ---- one-shot baseline: same requests, served serially -----------------
+    if not args.no_baseline:
+        shapes = sorted({(len(pr), int(nw)) for pr, nw in zip(prompts, news)})
+        for pl, nw in shapes:  # compile warmup, excluded from timing
+            generate_cached(
+                params, config, jnp.asarray([[1] * pl], jnp.int32),
+                jax.random.PRNGKey(0), max_new_tokens=nw,
+                temperature=args.temperature, top_k=args.top_k,
+            ).block_until_ready()
+        t0 = time.monotonic()
+        for pr, nw, key in zip(prompts, news, keys):
+            generate_cached(
+                params, config, jnp.asarray([pr], jnp.int32), key,
+                max_new_tokens=int(nw), temperature=args.temperature,
+                top_k=args.top_k,
+            ).block_until_ready()
+        base_wall = time.monotonic() - t0
+        result["oneshot_baseline"] = {
+            "wall_s": round(base_wall, 4),
+            "tok_s": round(total_new / base_wall, 1),
+            "tok_s_per_chip": round(
+                total_new / base_wall / jax.device_count(), 1
+            ),
+            "distinct_shapes_warmed": len(shapes),
+        }
+        if "engine" in result:
+            result["speedup_vs_oneshot"] = round(
+                result["engine"]["tok_s"]
+                / result["oneshot_baseline"]["tok_s"], 2
+            )
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -127,6 +127,57 @@ def test_generation_under_data_mesh_matches_single_device(tiny_config):
     np.testing.assert_array_equal(np.asarray(want), np.asarray(got_reforward))
 
 
+def test_zero_new_tokens_rejected_both_paths(tiny_config):
+    """max_new_tokens=0 fails the shared check in BOTH decode paths — the
+    serving engine rejects the same request at submit with the same error
+    (tests/test_serving.py), so no surface silently returns an empty
+    generation."""
+    import pytest
+
+    params = gpt2.init_params(tiny_config)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    for fn in (generate, generate_cached):
+        with pytest.raises(ValueError, match="max_new_tokens=0"):
+            fn(params, tiny_config, prompt, jax.random.PRNGKey(0),
+               max_new_tokens=0)
+
+
+def test_exact_context_fit_generates(tiny_config):
+    """prompt + max_new_tokens == n_positions is legal (the final sampled
+    token is emitted, never re-processed) and both paths agree — the
+    boundary the serving engine's block math leans on."""
+    params = gpt2.init_params(tiny_config)
+    p = tiny_config.n_positions - 5
+    prompt = jnp.ones((1, p), jnp.int32)
+    a = generate(params, tiny_config, prompt, jax.random.PRNGKey(0),
+                 max_new_tokens=5, temperature=0.0,
+                 compute_dtype=jnp.float32)
+    b = generate_cached(params, tiny_config, prompt, jax.random.PRNGKey(0),
+                        max_new_tokens=5, temperature=0.0,
+                        compute_dtype=jnp.float32)
+    assert a.shape == (1, tiny_config.n_positions)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_greedy_budget_is_prefix_stable(tiny_config):
+    """A shorter max_new_tokens yields a strict prefix of a longer greedy
+    run: each step depends only on the positions before it, never on the
+    remaining budget. This is what makes EOS-style early stopping (cutting
+    the stream at a token, as the serving engine does) exact — the tokens
+    before the cut are unchanged by where the run ends."""
+    params = gpt2.init_params(tiny_config)
+    prompt = jnp.asarray([[4, 9, 2]], jnp.int32)
+    long = generate_cached(params, tiny_config, prompt,
+                           jax.random.PRNGKey(0), max_new_tokens=12,
+                           temperature=0.0, compute_dtype=jnp.float32)
+    short = generate_cached(params, tiny_config, prompt,
+                            jax.random.PRNGKey(0), max_new_tokens=5,
+                            temperature=0.0, compute_dtype=jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(long)[:, : 3 + 5], np.asarray(short)
+    )
+
+
 def test_cached_bf16_default_runs(tiny_config):
     """The production default (bf16 cache + compute) runs and preserves the
     prompt; content may differ from fp32 by rounding."""

@@ -89,3 +89,21 @@ def test_top_k_bounds_rejected(tiny_config):
         with pytest.raises(ValueError, match="top_k"):
             generate(params, tiny_config, prompt, jax.random.PRNGKey(0),
                      max_new_tokens=4, top_k=bad)
+
+
+def test_check_generation_args_serving_bounds(tiny_config):
+    """The shared admission check (one validator for generate,
+    generate_cached, and the serving engine): batch and new-token floors,
+    context ceiling, and the happy path returning the total length."""
+    from gpt_2_distributed_tpu.models.generate import check_generation_args
+
+    assert check_generation_args(tiny_config, 3, 5, None) == 8
+    assert check_generation_args(tiny_config, 3, 5, 20, batch=8) == 8
+    with pytest.raises(ValueError, match="batch=0"):
+        check_generation_args(tiny_config, 3, 5, None, batch=0)
+    with pytest.raises(ValueError, match="max_new_tokens=0"):
+        check_generation_args(tiny_config, 3, 0, None)
+    with pytest.raises(ValueError, match="prompt_len=0"):
+        check_generation_args(tiny_config, 0, 5, None)
+    with pytest.raises(ValueError, match="exceeds n_positions"):
+        check_generation_args(tiny_config, tiny_config.n_positions, 1, None)

@@ -55,6 +55,35 @@ def test_sample_from_save_dir_both_paths_agree(capsys, trained_ckpt):
     assert cached == reforward  # exact greedy agreement through the CLI
 
 
+def test_sample_stream_matches_cached_path(capsys, trained_ckpt):
+    # --stream decodes through the serving engine's paged KV cache but must
+    # print the SAME token stream as the contiguous cached path (the
+    # engine's exactness contract, surfaced at the CLI).
+    common = [
+        "--ckpt", trained_ckpt, *MODEL_FLAGS,
+        "--prompt_ids", "5,6,7", "--new", "6", "--temperature", "0",
+    ]
+    cached = run_sample(capsys, *common, "--decode_path", "cached")
+    streamed = run_sample(capsys, *common, "--stream")
+    assert streamed == cached
+    # Sampling too: same seed, same stream.
+    warm = [
+        "--ckpt", trained_ckpt, *MODEL_FLAGS,
+        "--prompt_ids", "5,6,7", "--new", "6",
+        "--temperature", "0.9", "--top_k", "40", "--seed", "11",
+    ]
+    sampled = run_sample(capsys, *warm, "--decode_path", "cached")
+    sampled_stream = run_sample(capsys, *warm, "--stream")
+    assert sampled_stream == sampled
+
+
+def test_sample_stream_rejects_reforward(capsys, trained_ckpt):
+    with pytest.raises(SystemExit):
+        run_sample(capsys, "--ckpt", trained_ckpt, *MODEL_FLAGS,
+                   "--prompt_ids", "5", "--stream",
+                   "--decode_path", "reforward")
+
+
 def test_sample_rejects_bad_args(capsys, trained_ckpt):
     with pytest.raises(SystemExit):
         run_sample(capsys, "--ckpt", trained_ckpt, *MODEL_FLAGS,

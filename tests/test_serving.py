@@ -1,0 +1,407 @@
+"""Serving subsystem: block allocator units, engine bit-parity against
+``generate_cached``, compile-once across admission/eviction churn, EOS
+eviction, padding edges, streaming, and the bench_serve CLI contract.
+
+The exactness bar is deliberately BIT-equality, not allclose: the decode
+step mirrors ``decode.decode_step`` op-for-op with batch a parallel dim
+throughout, and each slot carries its own PRNG chain in generate_cached's
+split order — so a request's tokens cannot depend on who shares the batch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpt_2_distributed_tpu.config import ServeConfig
+from gpt_2_distributed_tpu.models import gpt2
+from gpt_2_distributed_tpu.models.decode import generate_cached
+from gpt_2_distributed_tpu.serving import BlockAllocator, ServingEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_SERVE = os.path.join(REPO, "scripts", "bench_serve.py")
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_config):
+    return gpt2.init_params(tiny_config, seed=0)
+
+
+def _serve(**kw):
+    base = dict(max_batch=4, block_size=8, num_blocks=32, attn_impl="xla")
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _oneshot(params, config, prompt, key, new, **kw):
+    """generate_cached batch-1 reference; returns just the NEW tokens."""
+    out = generate_cached(
+        params, config, jnp.asarray([prompt], jnp.int32), key,
+        max_new_tokens=new, **kw,
+    )
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# --------------------------------------------------------------- allocator
+
+
+class TestBlockAllocator:
+    def test_all_or_nothing_and_null_block_reserved(self):
+        a = BlockAllocator(8)           # blocks 1..7 allocatable
+        assert a.available == 7
+        ids = a.alloc(7)
+        assert sorted(ids) == list(range(1, 8))  # block 0 never handed out
+        assert a.alloc(1) is None       # empty pool -> None, not partial
+        a.release(ids[:3])
+        assert a.available == 3
+        assert a.alloc(4) is None       # 4 > 3: free list left untouched
+        assert a.available == 3
+        assert len(a.alloc(3)) == 3
+
+    def test_double_free_and_foreign_ids_are_loud(self):
+        a = BlockAllocator(8)
+        ids = a.alloc(2)
+        a.release(ids)
+        with pytest.raises(ValueError, match="double free"):
+            a.release(ids)
+        with pytest.raises(ValueError, match="not an allocated block"):
+            a.release([0])              # the null block
+        with pytest.raises(ValueError, match="need at least one"):
+            a.alloc(0)
+
+    def test_too_small_pool_rejected(self):
+        with pytest.raises(ValueError, match="num_blocks=1"):
+            BlockAllocator(1)
+        with pytest.raises(ValueError):
+            ServeConfig(max_batch=0)
+        with pytest.raises(ValueError):
+            ServeConfig(block_size=0)
+
+
+# ----------------------------------------------------- engine bit-parity
+
+
+def _mixed_trace():
+    prompts = [[1, 2, 3], [7, 8, 9, 10, 11], [42], [5, 6], [200, 201, 202]]
+    news = [10, 7, 12, 1, 9]
+    keys = [jax.random.PRNGKey(100 + i) for i in range(5)]
+    return prompts, news, keys
+
+
+def test_engine_greedy_bit_matches_generate_cached(tiny_params, tiny_config):
+    prompts, news, keys = _mixed_trace()
+    eng = ServingEngine(tiny_params, tiny_config, _serve(), temperature=0.0)
+    handles = [eng.submit(p, n, rng=k)
+               for p, n, k in zip(prompts, news, keys)]
+    eng.run_until_idle(max_steps=200)
+    for h, p, n, k in zip(handles, prompts, news, keys):
+        ref = _oneshot(tiny_params, tiny_config, p, k, n, temperature=0.0)
+        assert h.generated == ref, h.id
+        assert h.done and h.finish_reason == "length"
+    # All blocks back after drain; no leak across the whole trace.
+    assert eng.allocator.available == eng.serve.num_blocks - 1
+
+
+def test_engine_sampled_bit_matches_generate_cached(tiny_params, tiny_config):
+    # temperature>0 + top_k: the per-slot PRNG chains must replay the exact
+    # threefry split order of the one-shot path regardless of batch mates.
+    prompts, news, keys = _mixed_trace()
+    eng = ServingEngine(tiny_params, tiny_config, _serve(),
+                        temperature=0.9, top_k=40)
+    handles = [eng.submit(p, n, rng=k)
+               for p, n, k in zip(prompts, news, keys)]
+    eng.run_until_idle(max_steps=200)
+    for h, p, n, k in zip(handles, prompts, news, keys):
+        ref = _oneshot(tiny_params, tiny_config, p, k, n,
+                       temperature=0.9, top_k=40)
+        assert h.generated == ref, h.id
+
+
+def test_compile_once_across_admission_eviction_churn(
+    tiny_params, tiny_config,
+):
+    # 9 requests through 2 slots: continuous admission backfills as rows
+    # evict, and the decode step must stay ONE compiled program throughout —
+    # churn changes array contents, never shapes.
+    serve = _serve(max_batch=2, num_blocks=16)
+    eng = ServingEngine(tiny_params, tiny_config, serve, temperature=0.0)
+    rng = np.random.default_rng(3)
+    specs = [
+        (rng.integers(0, tiny_config.vocab_size,
+                      int(rng.integers(1, 12))).tolist(),
+         int(rng.integers(2, 9)))
+        for _ in range(9)
+    ]
+    handles = [eng.submit(p, n, rng=jax.random.PRNGKey(i))
+               for i, (p, n) in enumerate(specs)]
+    eng.run_until_idle(max_steps=500)
+    assert eng._decode_fn._cache_size() == 1
+    # Prefill compiles per bucket, not per prompt length.
+    buckets = {-(-len(p) // serve.block_size) for p, _ in specs}
+    assert eng._prefill_fn._cache_size() == len(buckets)
+    assert eng.stats["admitted"] == 9 and eng.stats["finished"] == 9
+    assert eng.allocator.available == serve.num_blocks - 1
+    # Every interleaving still bit-matches its solo reference.
+    for h, (p, n), i in zip(handles, specs, range(9)):
+        ref = _oneshot(tiny_params, tiny_config, p,
+                       jax.random.PRNGKey(i), n, temperature=0.0)
+        assert h.generated == ref, h.id
+
+
+def test_fifo_admission_head_of_line(tiny_params, tiny_config):
+    # One slot: requests must complete in submission order even though
+    # later ones are shorter (no queue jumping past a waiting head).
+    serve = _serve(max_batch=1, num_blocks=16)
+    eng = ServingEngine(tiny_params, tiny_config, serve, temperature=0.0)
+    hs = [
+        eng.submit([1, 2, 3], 8, rng=0),
+        eng.submit([4, 5], 2, rng=1),
+        eng.submit([6], 3, rng=2),
+    ]
+    eng.run_until_idle(max_steps=200)
+    assert all(h.done for h in hs)
+    assert [h.finish_time for h in hs] == sorted(h.finish_time for h in hs)
+    # With one slot there is never more than one request in flight, so
+    # first-token times are FIFO too.
+    assert [h.first_token_time for h in hs] == sorted(
+        h.first_token_time for h in hs
+    )
+
+
+def test_eos_evicts_early_and_releases_blocks(tiny_params, tiny_config):
+    # Sample a varied stream first, then replay it with eos_id set to a
+    # token that first appears mid-stream: generation must cut exactly
+    # there, report "eos", and hand every block back.
+    p, n, key = [1, 2, 3], 10, jax.random.PRNGKey(100)
+    full = _oneshot(tiny_params, tiny_config, p, key, n,
+                    temperature=0.9, top_k=40)
+    k = next(i for i in range(1, len(full)) if full[i] not in full[:i])
+    serve = _serve(eos_id=full[k])
+    eng = ServingEngine(tiny_params, tiny_config, serve,
+                        temperature=0.9, top_k=40)
+    h = eng.submit(p, n, rng=key)
+    eng.run_until_idle(max_steps=100)
+    assert h.finish_reason == "eos"
+    assert h.generated == full[:k + 1]   # the EOS token itself is emitted
+    assert eng.allocator.available == serve.num_blocks - 1
+
+
+def test_finish_at_prefill_max_new_one(tiny_params, tiny_config):
+    # max_new_tokens=1 finishes inside admission: first token only, no
+    # decode steps, blocks returned without ever scattering.
+    eng = ServingEngine(tiny_params, tiny_config, _serve(), temperature=0.0)
+    h = eng.submit([5, 6, 7], 1, rng=0)
+    eng.run_until_idle(max_steps=10)
+    ref = _oneshot(tiny_params, tiny_config, [5, 6, 7],
+                   jax.random.PRNGKey(0), 1, temperature=0.0)
+    assert h.generated == ref and h.finish_reason == "length"
+    assert eng.stats["decode_steps"] == 0
+    assert eng.allocator.available == eng.serve.num_blocks - 1
+
+
+def test_padding_edges_block_multiple_and_exact_context_fit(
+    tiny_params, tiny_config,
+):
+    # Prompt exactly a block multiple (no pad), and prompt+new == the full
+    # context window (the last writable position is used, never exceeded).
+    npos = tiny_config.n_positions
+    cases = [
+        ([3] * 8, 5),               # len == block_size -> zero right-pad
+        ([7] * (npos - 6), 6),      # exact fit: P + new == n_positions
+    ]
+    serve = _serve(num_blocks=2 * (npos // 8) + 1)
+    eng = ServingEngine(tiny_params, tiny_config, serve, temperature=0.0)
+    hs = [eng.submit(p, n, rng=jax.random.PRNGKey(9)) for p, n in cases]
+    eng.run_until_idle(max_steps=200)
+    for h, (p, n) in zip(hs, cases):
+        ref = _oneshot(tiny_params, tiny_config, p,
+                       jax.random.PRNGKey(9), n, temperature=0.0)
+        assert h.generated == ref, (len(p), n)
+
+
+def test_prefill_bucket_straddles_n_positions(tiny_params, tiny_config):
+    # block_size=12 on n_positions=64: a 61-token prompt buckets to 72,
+    # past the position table — the forward runs at 64, K/V zero-pad to the
+    # scatter width, and the result still bit-matches the one-shot path.
+    npos = tiny_config.n_positions
+    assert npos % 12 != 0
+    p = [11] * (npos - 3)
+    serve = _serve(block_size=12, num_blocks=16)
+    eng = ServingEngine(tiny_params, tiny_config, serve, temperature=0.0)
+    h = eng.submit(p, 3, rng=jax.random.PRNGKey(4))
+    eng.run_until_idle(max_steps=50)
+    ref = _oneshot(tiny_params, tiny_config, p,
+                   jax.random.PRNGKey(4), 3, temperature=0.0)
+    assert h.generated == ref
+
+
+def test_pallas_engine_matches_xla_engine(tiny_params, tiny_config):
+    prompts, news, keys = _mixed_trace()
+    outs = {}
+    for impl in ("xla", "pallas"):
+        eng = ServingEngine(tiny_params, tiny_config,
+                            _serve(attn_impl=impl), temperature=0.0)
+        hs = [eng.submit(p, n, rng=k)
+              for p, n, k in zip(prompts[:3], news[:3], keys[:3])]
+        eng.run_until_idle(max_steps=200)
+        outs[impl] = [h.generated for h in hs]
+    assert outs["pallas"] == outs["xla"]
+
+
+def test_streaming_callbacks_order_and_ttft(tiny_params, tiny_config):
+    got = []
+    eng = ServingEngine(tiny_params, tiny_config, _serve(), temperature=0.0)
+    h = eng.submit([1, 2, 3], 6, rng=0,
+                   on_token=lambda req, t: got.append((req.id, t)))
+    eng.run_until_idle(max_steps=50)
+    # Every token streamed, in generation order, tagged with the request.
+    assert got == [(h.id, t) for t in h.generated]
+    assert len(h.generated) == 6
+    # The timestamps the bench derives TTFT/latency from are all stamped
+    # and ordered: submit <= first token <= finish.
+    assert h.submit_time <= h.first_token_time <= h.finish_time
+
+
+def test_submit_validation_shared_with_decode_paths(
+    tiny_params, tiny_config,
+):
+    eng = ServingEngine(tiny_params, tiny_config, _serve(), temperature=0.0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1, 2], 0, rng=0)
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.submit([1] * tiny_config.n_positions, 4, rng=0)
+    # A request too big for the WHOLE pool can never be admitted: rejected
+    # at submit, not deadlocked in the queue.
+    small = ServingEngine(
+        tiny_params, tiny_config, _serve(num_blocks=3), temperature=0.0,
+    )
+    with pytest.raises(ValueError, match="could never be admitted"):
+        small.submit([1] * 20, 10, rng=0)
+    # Engine-level sampling config fails the same shared check.
+    with pytest.raises(ValueError, match="top_k"):
+        ServingEngine(tiny_params, tiny_config, _serve(),
+                      temperature=1.0, top_k=0)
+
+
+# ------------------------------------------------------ bench_serve CLI
+
+
+def _run_bench_serve(*argv, poison_jax_dir=None, timeout=120):
+    env = dict(os.environ)
+    if poison_jax_dir is not None:
+        env["PYTHONPATH"] = (
+            poison_jax_dir + os.pathsep + env.get("PYTHONPATH", "")
+        )
+    return subprocess.run(
+        [sys.executable, BENCH_SERVE, *argv], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def _poison(tmp_path):
+    d = tmp_path / "poison"
+    d.mkdir()
+    (d / "jax.py").write_text(
+        "raise ImportError('bench_serve touched jax at parse time')"
+    )
+    return str(d)
+
+
+def test_bench_serve_help_is_jax_free(tmp_path):
+    r = _run_bench_serve("--help", poison_jax_dir=_poison(tmp_path))
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "--rate" in r.stdout
+
+
+def test_bench_serve_rejects_unhonorable_flags(tmp_path):
+    # Parse-time refusals, before any jax import (bench.py's --suite
+    # pattern): contradictions and impossible traces fail fast and name
+    # the flag.
+    poison = _poison(tmp_path)
+    for flags, named in (
+        (("--baseline_only", "--no_baseline"), "--baseline_only"),
+        (("--requests", "0"), "--requests"),
+        (("--rate", "0"), "--rate"),
+        (("--prompt_min", "0"), "--prompt_min"),
+        (("--new_min", "9", "--new_max", "3"), "--new_min"),
+    ):
+        r = _run_bench_serve(*flags, poison_jax_dir=poison)
+        assert r.returncode != 0, flags
+        assert named in r.stderr, (flags, r.stderr[-300:])
+
+
+def test_bench_serve_rejects_trace_exceeding_context(capsys):
+    # This refusal needs the model config (n_positions), so it runs after
+    # the jax import — exercise it in-process to keep it cheap.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_serve", BENCH_SERVE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with pytest.raises(SystemExit):
+        mod.main(["--seq_len", "64", "--prompt_max", "40",
+                  "--new_max", "40"])
+    assert "n_positions" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_bench_serve_end_to_end(tmp_path):
+    # Full trace on the tiny config: engine + baseline, JSON artifact
+    # written, continuous batching reported against the one-shot path.
+    out = tmp_path / "bench_serve.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, BENCH_SERVE,
+         "--n_layer", "2", "--n_embd", "32", "--n_head", "2",
+         "--vocab_size", "257", "--seq_len", "64",
+         "--requests", "8", "--prompt_min", "2", "--prompt_max", "10",
+         "--new_min", "4", "--new_max", "10",
+         "--max_batch", "4", "--block_size", "8",
+         "--json", str(out)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["engine"]["tok_s"] > 0
+    assert rec["engine"]["decode_steps"] > 0
+    assert rec["oneshot_baseline"]["tok_s"] > 0
+    assert rec["speedup_vs_oneshot"] > 0
+    assert json.loads(out.read_text()) == rec
+
+
+@pytest.mark.slow
+def test_serve_cli_end_to_end_stream(tmp_path):
+    # gpt2-tpu-serve over a JSONL request file with --stream: one token
+    # line per generated token plus a final record per request.
+    reqs = tmp_path / "reqs.jsonl"
+    reqs.write_text(
+        '{"prompt_ids": [1, 2, 3], "new": 4, "seed": 0}\n'
+        '{"prompt_ids": [9, 8], "new": 3, "seed": 1}\n'
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "gpt_2_distributed_tpu.serving.serve",
+         "--init_random",
+         "--n_layer", "2", "--n_embd", "32", "--n_head", "2",
+         "--vocab_size", "257", "--seq_len", "64",
+         "--requests", str(reqs), "--temperature", "0",
+         "--max_batch", "2", "--block_size", "8", "--stream"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(x) for x in r.stdout.strip().splitlines()]
+    finals = [x for x in lines if "generated" in x]
+    streams = [x for x in lines if "token" in x]
+    assert len(finals) == 2
+    assert {f["finish_reason"] for f in finals} == {"length"}
+    for f in finals:
+        toks = [s["token"] for s in streams if s["id"] == f["id"]]
+        assert toks == f["generated"]
+        assert f["ttft_ms"] >= 0
