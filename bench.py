@@ -498,7 +498,7 @@ def run_config(args, model: str, seq_len: int) -> dict:
         getattr(args, "shard_update", "off"), mesh
     )
     with activate_mesh(mesh):
-        params, opt_state, _, _ = shard_params_and_opt_state(
+        params, opt_state, pshard, oshard = shard_params_and_opt_state(
             params, optimizer, mesh, shard_update=use_shard_update
         )
         accum_bf16 = args.accum_dtype == "bf16" or (
@@ -616,6 +616,7 @@ def run_config(args, model: str, seq_len: int) -> dict:
         update_ms = max(0.0, dt / steps * 1e3 - accum_ms)
 
         ckpt_drain_ms = None
+        restore_ms = None
         if saver is not None:
             # Background commits still running after the loop are real work
             # the run pays eventually — measured separately from dt, which is
@@ -623,6 +624,18 @@ def run_config(args, model: str, seq_len: int) -> dict:
             t_drain = time.perf_counter()
             saver.close()
             ckpt_drain_ms = (time.perf_counter() - t_drain) * 1e3
+            # Restore + reshard wall time: what an elastic resume pays before
+            # the first post-resize step. Restores onto the live shardings,
+            # so the on-mesh placement cost is inside the number.
+            latest = ckpt_mod.latest_checkpoint(ckpt_dir)
+            if latest:
+                t_r = time.perf_counter()
+                r_params, r_opt, _ = ckpt_mod.restore_checkpoint(
+                    latest, params, opt_state, pshard, oshard
+                )
+                jax.block_until_ready((r_params, r_opt))
+                restore_ms = (time.perf_counter() - t_r) * 1e3
+                del r_params, r_opt
             if ckpt_tmp_dir:
                 shutil.rmtree(ckpt_tmp_dir, ignore_errors=True)
 
@@ -649,6 +662,9 @@ def run_config(args, model: str, seq_len: int) -> dict:
                 round(float(np.max(ckpt_block_ms)), 2) if ckpt_block_ms else None
             ),
             "ckpt_drain_ms": round(ckpt_drain_ms, 2),
+            "restore_ms": (
+                round(restore_ms, 2) if restore_ms is not None else None
+            ),
         }
 
     return {

@@ -89,6 +89,14 @@ class CheckpointMeta:
     # (resilience.py). None for guard-off runs; the default keeps meta.json
     # files written before this field loadable (from_json passes **kwargs).
     spike_monitor: dict | None = None
+    # The world this checkpoint was saved at — what elastic resume needs to
+    # re-mesh, rescale grad-accum, and migrate the data cursor when the
+    # host/device count changes across a restart. Keys (all ints except
+    # "mesh", a MeshSpec string like "data=2,fsdp=4,sp=1,tp=1"):
+    # process_count, device_count (mesh size, not jax.device_count()), mesh,
+    # global_batch, grad_accum_steps, batch, local_batch, workers. None for
+    # pre-elastic checkpoints (same legacy-JSON contract as spike_monitor).
+    world: dict | None = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2)
@@ -223,6 +231,28 @@ def list_checkpoints(
             continue
         out.append((int(m.group(1)), path))
     return sorted(out)
+
+
+def peek_latest_meta(save_dir: str) -> CheckpointMeta | None:
+    """Read the newest restorable checkpoint's meta.json WITHOUT touching the
+    arrays.
+
+    The elastic-resume hook needs the saved world record (mesh spec, device
+    count, global batch) before the driver has built a mesh — i.e. long
+    before ``restore_latest_verified`` runs — so this walks the same
+    committed-checkpoint list newest-first and returns the first meta that
+    parses. A checkpoint whose meta.json is unreadable is skipped, mirroring
+    restore's fall-back-past-corrupt behavior; corruption confined to the
+    array files is caught later by restore itself (the driver re-checks that
+    the meta it restored agrees with the world peeked here).
+    """
+    for _, path in reversed(list_checkpoints(save_dir)):
+        try:
+            with open(os.path.join(path, "meta.json")) as f:
+                return CheckpointMeta.from_json(f.read())
+        except (OSError, ValueError, TypeError, KeyError):
+            continue
+    return None
 
 
 def list_uncommitted(save_dir: str) -> list[str]:

@@ -217,6 +217,37 @@ def check_fingerprints(fingerprint: float) -> list[int]:
     return mismatched_ranks([float(v) for v in gathered])
 
 
+def assert_pod_agreement(name: str, value: float) -> None:
+    """Startup barrier for elastic resume: every host allgathers ``value`` and
+    the pod fails loudly if any rank disagrees, naming the minority ranks.
+
+    After a world resize each host independently peeks the checkpoint's world
+    record and re-derives the mesh / grad-accum rescale; a host reading a
+    stale save_dir replica (or launched with drifted flags) would otherwise
+    desync the pod on the first collective. No-op single-process; doubles as
+    a rendezvous, so the new (smaller) world has barriered before any real
+    collective runs.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    gathered = np.ravel(
+        multihost_utils.process_allgather(np.asarray(value, np.float64))
+    )
+    bad = mismatched_ranks([float(v) for v in gathered])
+    if bad:
+        raise RuntimeError(
+            f"pod disagrees on {name} at startup: rank(s) "
+            f"{', '.join(str(r) for r in bad)} differ "
+            f"(gathered {[float(v) for v in gathered]}); all hosts must "
+            f"observe the same checkpoint world record and launch flags"
+        )
+
+
 def mismatched_ranks(values: list[float]) -> list[int]:
     """Ranks whose value differs from the modal value (ties broken toward the
     lowest rank's value, so a 1v1 split blames the higher rank)."""

@@ -145,6 +145,26 @@ class TokenShardDataset:
         self.read_retry_count = 0
         self._retry_lock = threading.Lock()
         self._epoch = 0
+        # Elastic-resume cursor migration (set_consumed): per-shard sets of
+        # window offsets a PREVIOUS world already trained on this epoch.
+        # Active only for the epoch it was installed for — set_epoch to any
+        # other epoch clears it.
+        self._consumed: dict[str, frozenset] | None = None
+        self._consumed_epoch: int | None = None
+
+    def set_consumed(self, consumed: dict[str, set], epoch: int) -> None:
+        """Install a consumed-window plan (see :func:`plan_cursor_migration`)
+        for ``epoch``: the listed ``{shard_path: {offset, ...}}`` windows are
+        excluded from iteration and from all window counts, so a world of ANY
+        shape resumes the epoch on exactly the complement — no window is
+        double-read or dropped. Shard-stride mode only (window-stride eval
+        loaders have no resume cursor)."""
+        if self.shard_windows:
+            raise ValueError(
+                "set_consumed is only supported in shard-stride mode"
+            )
+        self._consumed = {p: frozenset(offs) for p, offs in consumed.items()}
+        self._consumed_epoch = int(epoch)
 
     def _retry_io(self, fn, what: str):
         """Run ``fn``, retrying transient ``OSError`` up to
@@ -170,6 +190,11 @@ class TokenShardDataset:
     # Parity with the reference's set_epoch (``/root/reference/dataloader.py:162-171``).
     def set_epoch(self, epoch: int) -> None:
         self._epoch = int(epoch)
+        if self._consumed is not None and self._epoch != self._consumed_epoch:
+            # Only the checkpointed epoch was partially consumed by the old
+            # world; later epochs start from their full window set.
+            self._consumed = None
+            self._consumed_epoch = None
 
     @property
     def epoch(self) -> int:
@@ -242,6 +267,12 @@ class TokenShardDataset:
             random.Random(
                 _offset_seed(epoch, self.process_index, worker_id)
             ).shuffle(offsets)
+            consumed = self._consumed.get(path) if self._consumed else None
+            if consumed:
+                # Elastic-resume migration: windows the old world already
+                # trained on are excluded; the shuffled order of the
+                # survivors is preserved.
+                offsets = [o for o in offsets if o not in consumed]
         remaining = offsets[start_offset_index:]
         window_len = self.seq_len + 1
 
@@ -296,6 +327,10 @@ class TokenShardDataset:
         size alone — no reads. The full count in shard-stride mode."""
         n = _shard_token_count(path)
         total = len(range(0, n - self.seq_len - 1, self.seq_len))
+        if not self.shard_windows and self._consumed:
+            # Consumed offsets come from the same enumeration, so the count
+            # shrinks one-for-one (clamped defensively).
+            total -= min(len(self._consumed.get(path, ())), total)
         start, stride = self._window_slice(worker_id)
         return len(range(start, total, stride))
 
@@ -395,6 +430,63 @@ def _simulate_round_robin_skip(
         n += 1
         i = pos + 1
     return skipped, live, i
+
+
+def plan_cursor_migration(
+    shard_paths: Sequence[str],
+    seq_len: int,
+    epoch: int,
+    old_process_count: int,
+    old_num_workers: int,
+    old_batch_size: int,
+    consumed_batches: int,
+) -> dict[str, set]:
+    """Reconstruct exactly which windows the OLD world consumed this epoch.
+
+    Elastic resume changes the ``(process, worker)`` partitioning — both the
+    ``epoch ^ rank ^ worker`` offset-shuffle seeds and the owned-shard slices
+    depend on world size — so a resumed run at a new world cannot use the
+    arithmetic prefix skip: its streams are different streams. Instead this
+    replays the old world's deterministic consumption purely from metadata
+    (file sizes + seeds, no token reads): for each old process, the
+    round-robin simulation splits ``consumed_batches`` across its workers,
+    and each worker's share maps to the head of its shuffled offset list,
+    shard by shard in owned order. The returned ``{shard_path: {offset,...}}``
+    plan feeds :meth:`TokenShardDataset.set_consumed` on a dataset of ANY new
+    world shape: the new world trains on exactly the complement.
+
+    ``consumed_batches`` is per old process (identical across processes:
+    optimizer steps into the epoch x the old world's grad-accum). Limitation:
+    after a SECOND resize within the same epoch the old world's own consumed
+    set is not recoverable from the latest checkpoint alone, so the plan
+    treats the latest world as having consumed the whole epoch prefix —
+    approximate there, exact everywhere else.
+    """
+    plan: dict[str, set] = {}
+    for p in range(old_process_count):
+        old = TokenShardDataset(
+            shard_paths,
+            seq_len=seq_len,
+            process_index=p,
+            process_count=old_process_count,
+            num_workers=old_num_workers,
+        )
+        old.set_epoch(epoch)
+        counts = old.worker_batches(old_batch_size)
+        skipped, _, _ = _simulate_round_robin_skip(counts, consumed_batches)
+        for w in range(old.num_workers):
+            samples = skipped[w] * old_batch_size
+            for path in old.worker_shards(w, epoch):
+                if samples <= 0:
+                    break
+                n = _shard_token_count(path)
+                offsets = list(range(0, n - seq_len - 1, seq_len))
+                random.Random(_offset_seed(epoch, p, w)).shuffle(offsets)
+                take = min(samples, len(offsets))
+                if take:
+                    plan.setdefault(path, set()).update(offsets[:take])
+                samples -= take
+    return plan
 
 
 class _WorkerThread(threading.Thread):

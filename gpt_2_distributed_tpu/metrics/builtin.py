@@ -107,6 +107,21 @@ METRIC_REGISTRY.metric(
     cli_format="io_retry: {value:.0f}",
 )(lambda v: float(int(v)))
 
+# Elastic resume (train.py elastic hook): pushed only by runs that resumed at
+# a different world size than their checkpoint was saved at. elastic_resizes
+# is 1 for the life of such a run (summing across a supervised lifecycle's TB
+# series counts the resizes); resume_world_delta is new minus old device
+# count, so a shrink plots negative. TB-only — the [elastic] CLI line already
+# narrates the resize once.
+METRIC_REGISTRY.metric(
+    "elastic_resizes", reduction=ReductionStrategy.CURRENT,
+    cli_format=None,
+)(lambda v: float(int(v)))
+METRIC_REGISTRY.metric(
+    "resume_world_delta", reduction=ReductionStrategy.CURRENT,
+    cli_format=None,
+)(lambda v: float(int(v)))
+
 # Periodic validation loss over the held-out shard (shard 0 is reserved as
 # "val" by the tokenizer pipeline, notebook cell 13 convention). The reference
 # reserves the split but never consumes it; the TPU build's --eval_every wires

@@ -164,6 +164,12 @@ class MeshSpec:
             kwargs[key] = degree
         return cls(**kwargs)
 
+    def to_str(self) -> str:
+        """The inverse of :meth:`parse`: ``"data=2,fsdp=4,sp=1,tp=1"``.
+        Stored in checkpoint metadata so elastic resume can re-derive a mesh
+        from the saved one."""
+        return f"data={self.data},fsdp={self.fsdp},sp={self.sp},tp={self.tp}"
+
     @classmethod
     def for_mode(cls, mode: str, n_devices: int | None = None) -> "MeshSpec":
         if n_devices is None:
@@ -175,6 +181,31 @@ class MeshSpec:
         if mode == "fsdp":
             return cls(1, n_devices)
         raise ValueError(f"unknown training_mode {mode!r}; expected one of {TRAINING_MODES}")
+
+
+def elastic_respec(saved: MeshSpec, n_devices: int) -> MeshSpec:
+    """Re-derive a mesh for a resized world by shrinking/growing the ``data``
+    axis and keeping the model-parallel axes (fsdp/sp/tp) fixed.
+
+    The model axes are pinned because their degrees are baked into per-layer
+    shardings and (for sp/tp) the attention/matmul partitioning itself — only
+    the batch axis can absorb a world change without touching model layout.
+    Raises ValueError naming the fixed axes and the nearest valid device
+    counts when ``n_devices`` is not a positive multiple of their product.
+    """
+    fixed = saved.fsdp * saved.sp * saved.tp
+    data, rem = divmod(n_devices, fixed)
+    if data < 1 or rem:
+        below = (n_devices // fixed) * fixed
+        valid = [v for v in (below, below + fixed) if v >= fixed]
+        raise ValueError(
+            f"cannot re-mesh {saved.to_str()} onto {n_devices} device(s): the "
+            f"model-parallel axes (fsdp={saved.fsdp}, sp={saved.sp}, "
+            f"tp={saved.tp}) are fixed across an elastic resize, so the "
+            f"device count must be a positive multiple of {fixed}; nearest "
+            f"valid device counts: {' or '.join(str(v) for v in valid)}"
+        )
+    return MeshSpec(data=data, fsdp=saved.fsdp, sp=saved.sp, tp=saved.tp)
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,7 @@ from gpt_2_distributed_tpu.data.dataloader import (
     TokenShardDataset,
     create_dataloader,
     get_shard_paths,
+    plan_cursor_migration,
 )
 
 DEFAULT_SEED = 42  # reference global seed, /root/reference/train_gpt2_distributed.py:39
@@ -316,6 +317,22 @@ def build_parser() -> argparse.ArgumentParser:
         "requires --hang_timeout_s > 0.",
     )
     p.add_argument(
+        "--inject_world_size", type=int, default=0,
+        help="fault injection for the elastic path: pretend the observed "
+        "world has N devices at resume, so the re-mesh + grad-accum rescale "
+        "+ cursor migration run on a single CPU host without a pod. The "
+        "checkpoint's saved world record is compared against N instead of "
+        "the real device count. 0 = off; requires --resume and --save_dir.",
+    )
+    p.add_argument(
+        "--dropout", type=float, default=None,
+        help="override every dropout rate (embedding, attention, residual) "
+        "with one value; default keeps the preset's rates. --dropout 0 "
+        "makes runs deterministic across batch arrangements — required for "
+        "cross-world trajectory comparisons, since dropout masks are drawn "
+        "per position in the [accum, batch, seq] layout",
+    )
+    p.add_argument(
         "--inject_worker_fail_at", type=int, default=0,
         help="fault injection: data worker 0 on rank 0 raises after "
         "producing N batches, exercising worker-error propagation (single-"
@@ -419,6 +436,49 @@ def validate_mesh_for_config(spec, config, model_name: str, seq_len: int) -> Non
         )
 
 
+def elastic_rescale_accum(
+    saved_global_batch: int, batch: int, n_devices: int
+) -> int:
+    """The grad-accum count that holds the global batch constant across an
+    elastic world resize: ``global_batch = batch x n_devices x grad_accum``.
+
+    Raises ValueError when no integer rescale exists, naming the offending
+    values and the nearest valid operating points — exact
+    ``--batch``/``--grad_accum_steps`` pairs when the device count divides
+    the saved global batch, the nearest achievable global batches otherwise
+    (satellite: never a bare divisibility failure).
+    """
+    per_step = batch * n_devices
+    if saved_global_batch % per_step == 0:
+        return saved_global_batch // per_step
+    if saved_global_batch % n_devices == 0:
+        # The world can hold the global batch — just not with this --batch.
+        q = saved_global_batch // n_devices
+        pairs = sorted(
+            ((b, q // b) for b in range(1, q + 1) if q % b == 0),
+            key=lambda p: (abs(p[0] - batch), p[0]),
+        )
+        near = ", ".join(
+            f"--batch {b} --grad_accum_steps {a}" for b, a in pairs[:3]
+        )
+        raise ValueError(
+            f"global batch {saved_global_batch} (saved in the checkpoint) is "
+            f"not reconstructible with --batch {batch} at {n_devices} "
+            f"device(s): {saved_global_batch} / ({batch} x {n_devices}) = "
+            f"{saved_global_batch / per_step:.4g} grad-accum steps. Nearest "
+            f"valid operating points at {n_devices} device(s): {near}"
+        )
+    a_lo = max(1, saved_global_batch // per_step)
+    raise ValueError(
+        f"no --batch/--grad_accum_steps pair reproduces global batch "
+        f"{saved_global_batch} (saved in the checkpoint) at {n_devices} "
+        f"device(s) — {saved_global_batch} is not divisible by {n_devices}. "
+        f"Nearest achievable with --batch {batch}: --grad_accum_steps "
+        f"{a_lo} (global {a_lo * per_step}) or --grad_accum_steps "
+        f"{a_lo + 1} (global {(a_lo + 1) * per_step})"
+    )
+
+
 def _common_min(value: int) -> int:
     """Cross-process minimum of a host scalar (identity single-process).
 
@@ -475,6 +535,12 @@ def main(argv: list[str] | None = None) -> None:
         build_parser().error("--inject_hang_at requires --hang_timeout_s > 0 (otherwise the injected hang sleeps unwatched)")
     if args.inject_desync_at and not args.desync_check_every:
         build_parser().error("--inject_desync_at requires --desync_check_every > 0 (nothing would ever detect the injected divergence)")
+    if args.inject_world_size and not (args.resume and args.save_dir):
+        build_parser().error("--inject_world_size needs --resume and --save_dir (it overrides the observed world at resume; there is nothing to resize without a checkpoint)")
+    if args.inject_world_size < 0:
+        build_parser().error(f"--inject_world_size must be >= 1 device, got {args.inject_world_size}")
+    if args.dropout is not None and not (0.0 <= args.dropout < 1.0):
+        build_parser().error(f"--dropout must be in [0, 1), got {args.dropout}")
     try:
         coord_policy = CoordinationPolicy(
             desync_check_every=args.desync_check_every,
@@ -499,6 +565,7 @@ def main(argv: list[str] | None = None) -> None:
         MeshSpec,
         activate_mesh,
         create_mesh,
+        elastic_respec,
         init_distributed,
         is_primary,
     )
@@ -521,6 +588,7 @@ def main(argv: list[str] | None = None) -> None:
     from gpt_2_distributed_tpu.coordination import (
         ConsensusBus,
         HangWatchdog,
+        assert_pod_agreement,
         check_fingerprints,
         decode_control_word,
         encode_control_word,
@@ -565,6 +633,12 @@ def main(argv: list[str] | None = None) -> None:
         config = config.replace(fused_layers=args.fused_layers)
     if args.fused_matmul != "off":
         config = config.replace(fused_matmul=args.fused_matmul)
+    if args.dropout is not None:
+        config = config.replace(
+            embd_dropout=args.dropout,
+            attn_dropout=args.dropout,
+            resid_dropout=args.dropout,
+        )
 
     # --- mesh ---------------------------------------------------------------
     try:
@@ -572,6 +646,67 @@ def main(argv: list[str] | None = None) -> None:
         validate_mesh_for_config(spec, config, args.model, args.seq_len)
     except ValueError as e:
         raise SystemExit(f"error: {e}") from None
+
+    # --- elastic resume: survive a world resize ------------------------------
+    # When --resume finds a checkpoint saved at a different world size (a
+    # host lost to preemption, or --inject_world_size faking one), re-derive
+    # the mesh from the SAVED spec — only the data axis moves; fsdp/sp/tp are
+    # baked into the model layout — and rescale --grad_accum_steps so the
+    # global batch the optimizer sees is unchanged. The restored arrays
+    # reshard onto the new mesh for free: global shapes are unchanged, so the
+    # sharding-annotated restore targets re-place every leaf (including
+    # --shard_update's data-sharded moments, whose shard count follows the
+    # new data degree). Observable via elastic_resizes / resume_world_delta.
+    elastic_delta = 0
+    saved_world: dict | None = None
+    if args.resume and args.save_dir:
+        peeked = ckpt.peek_latest_meta(args.save_dir)
+        saved_world = peeked.world if peeked is not None else None
+    if saved_world:
+        saved_devices = int(saved_world["device_count"])
+        capacity = args.inject_world_size or jax.device_count()
+        respec_from = None
+        if args.inject_world_size and args.inject_world_size != saved_devices:
+            respec_from = capacity
+        elif spec.n_devices > capacity:
+            # The requested mesh no longer fits (a real host loss under an
+            # explicit --mesh); rebuild from the saved spec on what is left.
+            respec_from = capacity
+        if respec_from is not None:
+            try:
+                spec = elastic_respec(
+                    MeshSpec.parse(saved_world["mesh"]), respec_from
+                )
+                validate_mesh_for_config(spec, config, args.model, args.seq_len)
+            except ValueError as e:
+                raise SystemExit(f"error: elastic resume: {e}") from None
+        if spec.n_devices != saved_devices:
+            old_accum = args.grad_accum_steps
+            try:
+                args.grad_accum_steps = elastic_rescale_accum(
+                    int(saved_world["global_batch"]), args.batch, spec.n_devices
+                )
+            except ValueError as e:
+                raise SystemExit(f"error: elastic resume: {e}") from None
+            elastic_delta = spec.n_devices - saved_devices
+            if is_primary():
+                print(
+                    f"[elastic] world resized: {saved_devices} -> "
+                    f"{spec.n_devices} device(s) (saved mesh "
+                    f"{saved_world['mesh']} -> {spec.to_str()}); "
+                    f"--grad_accum_steps {old_accum} -> "
+                    f"{args.grad_accum_steps} holds the global batch at "
+                    f"{int(saved_world['global_batch'])}"
+                )
+        # Startup barrier: every host independently peeked the checkpoint and
+        # derived the new world — a rank reading a stale save_dir replica (or
+        # launched with drifted flags) must fail HERE, loudly, not desync the
+        # pod at the first training collective. Doubles as a rendezvous of
+        # the (possibly smaller) surviving world.
+        assert_pod_agreement("elastic device count", float(spec.n_devices))
+        assert_pod_agreement(
+            "elastic grad_accum_steps", float(args.grad_accum_steps)
+        )
     mesh = create_mesh(spec)
     use_shard_update = resolve_shard_update(args.shard_update, mesh)
     # --batch is per device (DDP parity: the reference's --batch is per GPU
@@ -686,6 +821,12 @@ def main(argv: list[str] | None = None) -> None:
 
         # --- resume ---------------------------------------------------------
         start_epoch, skip_steps, global_step, total_tokens = 0, 0, 0, 0
+        # Cursor-migration state: when a world resize re-partitions the
+        # loader, the old world's consumption is excluded via a consumed-
+        # window plan instead of the arithmetic prefix skip. cursor_base is
+        # the optimizer-step count that plan already accounts for in epoch
+        # cursor_epoch — the loader skips only steps taken SINCE the resize.
+        cursor_base, cursor_epoch = 0, -1
         if args.resume and args.save_dir:
             # Prune stale uncommitted dirs (a crash mid-async-save leaves one)
             # and apply retention before picking a restore candidate.
@@ -716,6 +857,64 @@ def main(argv: list[str] | None = None) -> None:
                     # is armed immediately instead of sitting out a fresh
                     # warmup window blind to spikes.
                     monitor.load_state_dict(meta.spike_monitor)
+                mw = meta.world or {}
+                if elastic_delta and mw and int(
+                    mw.get("global_batch", saved_world["global_batch"])
+                ) != int(saved_world["global_batch"]):
+                    # Restore fell back past a corrupt newest checkpoint onto
+                    # one saved at yet another world — the mesh/accum derived
+                    # from the peeked meta no longer match what was restored.
+                    raise SystemExit(
+                        f"error: elastic resume: restored {latest} was saved "
+                        f"at global batch {mw.get('global_batch')} but the "
+                        f"newest checkpoint's world record said "
+                        f"{saved_world['global_batch']} (restore fell back "
+                        f"past a corrupt checkpoint); delete the corrupt "
+                        f"newest step dir and relaunch"
+                    )
+                # Data-cursor migration: the loader's (process, worker)
+                # partitioning — shard ownership AND the epoch^rank^worker
+                # offset-shuffle seeds — changed with the world, so the
+                # arithmetic prefix skip would re-read some windows and drop
+                # others. Reconstruct exactly which windows the old world
+                # consumed this epoch and exclude them instead.
+                needed = (
+                    "process_count", "workers", "local_batch",
+                    "grad_accum_steps",
+                )
+                if skip_steps > 0 and all(k in mw for k in needed):
+                    old_shape = (
+                        int(mw["process_count"]), int(mw["workers"]),
+                        int(mw["local_batch"]),
+                    )
+                    new_shape = (
+                        jax.process_count(), dataset.num_workers, local_batch,
+                    )
+                    if old_shape != new_shape:
+                        plan = plan_cursor_migration(
+                            shard_paths,
+                            seq_len=args.seq_len,
+                            epoch=meta.epoch,
+                            old_process_count=old_shape[0],
+                            old_num_workers=old_shape[1],
+                            old_batch_size=old_shape[2],
+                            consumed_batches=(
+                                skip_steps * int(mw["grad_accum_steps"])
+                            ),
+                        )
+                        dataset.set_consumed(plan, epoch=meta.epoch)
+                        cursor_base, cursor_epoch = skip_steps, meta.epoch
+                        if is_primary():
+                            n_win = sum(len(v) for v in plan.values())
+                            print(
+                                f"[elastic] data cursor migrated: old world "
+                                f"(processes={old_shape[0]}, "
+                                f"workers={old_shape[1]}, "
+                                f"local_batch={old_shape[2]}) consumed "
+                                f"{n_win} windows over {len(plan)} shard(s) "
+                                f"this epoch; the new world resumes on the "
+                                f"complement"
+                            )
                 if is_primary():
                     print(
                         f"resumed from {latest}: step {global_step}, epoch "
@@ -737,12 +936,28 @@ def main(argv: list[str] | None = None) -> None:
         )
         tracker.total_tokens = total_tokens
 
+        # The world every checkpoint of this run is saved at — what a future
+        # elastic resume needs to re-mesh (mesh/device_count), hold the global
+        # batch (global_batch/batch/grad_accum_steps), and migrate the data
+        # cursor (process_count/workers/local_batch).
+        world_record = {
+            "process_count": jax.process_count(),
+            "device_count": spec.n_devices,
+            "mesh": spec.to_str(),
+            "global_batch": global_batch,
+            "grad_accum_steps": args.grad_accum_steps,
+            "batch": args.batch,
+            "local_batch": local_batch,
+            "workers": dataset.num_workers,
+        }
+
         def make_meta(step: int, ep: int, batches: int) -> "ckpt.CheckpointMeta":
             return ckpt.CheckpointMeta(
                 step=step, epoch=ep, batches_in_epoch=batches,
                 rng_seed=args.seed,
                 total_tokens=tracker.total_tokens,
                 spike_monitor=monitor.state_dict() if monitor else None,
+                world=world_record,
             )
 
         # --- evaluation -------------------------------------------------------
@@ -974,6 +1189,12 @@ def main(argv: list[str] | None = None) -> None:
                 # compiled shape, not per step). The warn-once fires at the
                 # fallback site; this keeps the signal on the metrics record.
                 extra["fused_fallback"] = fused_fallback_count()
+            if elastic_delta:
+                # This run resumed at a different world size than its
+                # checkpoint was saved at; constant for the run, so the TB
+                # series makes resizes (and their direction) visible.
+                extra["elastic_resizes"] = 1
+                extra["resume_world_delta"] = elastic_delta
             # p_step is the post-increment global step; optax evaluated the
             # schedule at count p_step - 1 for that update, so log that one.
             # A skipped step's loss/grad_norm are the REJECTED values (the
@@ -1062,7 +1283,10 @@ def main(argv: list[str] | None = None) -> None:
                     dataset,
                     batch_size=local_batch,
                     prefetch_factor=args.prefetch_factor,
-                    skip_batches=(skip_steps * args.grad_accum_steps) if epoch == start_epoch else 0,
+                    skip_batches=(
+                        (skip_steps - (cursor_base if epoch == cursor_epoch else 0))
+                        * args.grad_accum_steps
+                    ) if epoch == start_epoch else 0,
                     inject_worker_fail_after=(
                         args.inject_worker_fail_at
                         if (
@@ -1091,6 +1315,11 @@ def main(argv: list[str] | None = None) -> None:
                     _common_min(dataset.batches_per_epoch(local_batch))
                     // args.grad_accum_steps
                 )
+                if epoch == cursor_epoch:
+                    # batches_per_epoch counted only the complement of the
+                    # migrated (consumed) windows; the old world's steps are
+                    # still part of this epoch's step ledger.
+                    epoch_opt_steps += cursor_base
 
                 micro: list[tuple[np.ndarray, np.ndarray]] = []
                 last_micro: list[tuple[np.ndarray, np.ndarray]] = []

@@ -16,10 +16,27 @@
 #
 # Env knobs: MAX_RESTARTS (default 3) bounds relaunches, matching torchrun's
 # --max_restarts; RESTART_DELAY seconds between attempts (default 2).
+#
+# Elastic shrink-and-retry (off unless ELASTIC_HOSTS_CMD is set): on REPEATED
+# preemptions (rc 143) the lost host usually is not coming back — instead of
+# relaunching the full world forever, ask ELASTIC_HOSTS_CMD (any command that
+# prints the count of live hosts, e.g. a gcloud instance-list pipeline) how
+# many hosts survive, and relaunch only those with WORLD_SIZE shrunk to match.
+# train.py --resume re-meshes the saved checkpoint onto the smaller world and
+# rescales grad-accum to hold the global batch (the [elastic] path). Knobs:
+#   ELASTIC_HOSTS_CMD    command printing the live host count ("" = elastic off)
+#   ELASTIC_MIN_HOSTS    floor (default 1): refuse to shrink below this many
+#                        hosts and give up instead
+#   ELASTIC_SHRINK_AFTER consecutive rc-143s before probing for a shrink
+#                        (default 2: the first preemption retries at full
+#                        size — transient evictions usually reschedule)
 set -uo pipefail  # no -e: the exit code is inspected, not fatal
 
 MAX_RESTARTS="${MAX_RESTARTS:-3}"
 RESTART_DELAY="${RESTART_DELAY:-2}"
+ELASTIC_HOSTS_CMD="${ELASTIC_HOSTS_CMD:-}"
+ELASTIC_MIN_HOSTS="${ELASTIC_MIN_HOSTS:-1}"
+ELASTIC_SHRINK_AFTER="${ELASTIC_SHRINK_AFTER:-2}"
 
 # Extract --save_dir from the wrapped command line so the wrapper can clean
 # stale checkpoint dirs between attempts (both "--save_dir DIR" and
@@ -53,9 +70,15 @@ cleanup_stale() {
 }
 
 attempt=0
+preempt_streak=0
+world="${WORLD_SIZE:-}"
 while :; do
     cleanup_stale
-    "$@" --resume
+    if [ -n "$world" ]; then
+        WORLD_SIZE="$world" "$@" --resume
+    else
+        "$@" --resume
+    fi
     rc=$?
     if [ "$rc" -eq 0 ]; then
         exit 0
@@ -72,11 +95,37 @@ while :; do
         # committed emergency checkpoint and asked to be resumed — that's
         # cooperative rescheduling, not a failure, so it never burns one of
         # the MAX_RESTARTS crash attempts.
+        preempt_streak=$((preempt_streak + 1))
         echo "[supervise] preempted (rc=143); resuming from the emergency" \
-             "checkpoint (does not count against MAX_RESTARTS)" >&2
+             "checkpoint (attempt counter unchanged: ${attempt}/${MAX_RESTARTS})" >&2
+        if [ -n "$ELASTIC_HOSTS_CMD" ] && [ "$preempt_streak" -ge "$ELASTIC_SHRINK_AFTER" ]; then
+            # Repeated preemption: the lost host is likely gone for good.
+            # Probe the live host count and, if the world really shrank,
+            # relaunch the survivors smaller instead of retrying forever.
+            live="$($ELASTIC_HOSTS_CMD 2>/dev/null || true)"
+            expected="${world:-$live}"
+            case "$live" in
+                ''|*[!0-9]*) live="" ;;  # probe failed or non-numeric: skip
+            esac
+            if [ -n "$live" ] && [ "$live" -lt "$expected" ]; then
+                if [ "$live" -lt "$ELASTIC_MIN_HOSTS" ]; then
+                    echo "[supervise] elastic: only ${live} live host(s)," \
+                         "below ELASTIC_MIN_HOSTS=${ELASTIC_MIN_HOSTS};" \
+                         "refusing to shrink further — giving up (last rc=${rc})" >&2
+                    exit "$rc"
+                fi
+                echo "[supervise] elastic shrink: ${expected} -> ${live} host(s);" \
+                     "relaunching the survivors with WORLD_SIZE=${live}" \
+                     "(--resume re-meshes and rescales grad-accum;" \
+                     "does not count against MAX_RESTARTS)" >&2
+                world="$live"
+                preempt_streak=0
+            fi
+        fi
         sleep "$RESTART_DELAY"
         continue
     fi
+    preempt_streak=0
     if [ "$rc" -eq 170 ]; then
         # Hang watchdog (coordination.HangWatchdog, resilience.HANG_EXIT_CODE):
         # no optimizer step completed within --hang_timeout_s — a collective

@@ -1,0 +1,432 @@
+"""Elastic pod resize (ISSUE 9): resume a checkpoint at a different world
+size than it was saved at.
+
+Layers under test, bottom-up:
+
+* ``mesh.elastic_respec`` — re-derive a mesh for the new world (only the
+  'data' axis moves; fsdp/sp/tp are baked into the model layout).
+* ``train.elastic_rescale_accum`` — hold ``global_batch = batch x n_devices
+  x grad_accum`` constant by rescaling grad-accum, erroring loudly with the
+  nearest valid operating points when it can't.
+* ``checkpoint.peek_latest_meta`` / ``CheckpointMeta.world`` — the saved
+  world record the elastic hook reads before any mesh exists.
+* ``dataloader.plan_cursor_migration`` / ``set_consumed`` — re-partition the
+  resume cursor across a world change so no window is double-read or dropped.
+* Cross-world restore of ``--shard_update``'s data-sharded moments.
+* The end-to-end proof: a run saved at world size 2 resumes at world size 1
+  (``--inject_world_size``), the global batch is held by the accum rescale,
+  and the post-resume loss trajectory matches an uninterrupted run.
+"""
+
+import re
+from collections import Counter
+
+import jax
+import numpy as np
+import pytest
+
+from gpt_2_distributed_tpu import checkpoint as ckpt
+from gpt_2_distributed_tpu import train as train_mod
+from gpt_2_distributed_tpu.data.dataloader import (
+    TokenShardDataset,
+    get_shard_paths,
+    plan_cursor_migration,
+)
+from gpt_2_distributed_tpu.models import gpt2
+from gpt_2_distributed_tpu.parallel.mesh import (
+    MeshSpec,
+    activate_mesh,
+    create_mesh,
+    elastic_respec,
+)
+from gpt_2_distributed_tpu.parallel.sharding import shard_params_and_opt_state
+from gpt_2_distributed_tpu.parallel.train_step import make_optimizer
+
+from tests.test_train_cli import losses_from, run_cli
+
+
+# --- mesh re-derivation ------------------------------------------------------
+
+
+def test_elastic_respec_moves_only_the_data_axis():
+    saved = MeshSpec.parse("data=2,fsdp=4")
+    assert elastic_respec(saved, 4) == MeshSpec(data=1, fsdp=4)
+    assert elastic_respec(saved, 16) == MeshSpec(data=4, fsdp=4)
+    # sp/tp survive too.
+    saved = MeshSpec.parse("data=2,fsdp=2,sp=2")
+    assert elastic_respec(saved, 4) == MeshSpec(data=1, fsdp=2, sp=2)
+
+
+def test_elastic_respec_refuses_unmeshable_worlds():
+    saved = MeshSpec.parse("data=2,fsdp=4")
+    with pytest.raises(ValueError) as ei:
+        elastic_respec(saved, 6)
+    msg = str(ei.value)
+    # Names the fixed axes and the nearest valid device counts.
+    assert "fsdp=4" in msg and "multiple of 4" in msg
+    assert "4 or 8" in msg
+    with pytest.raises(ValueError, match="nearest valid device counts: 4"):
+        elastic_respec(saved, 2)
+
+
+def test_mesh_spec_to_str_roundtrips():
+    for text in ("data=2,fsdp=4", "data=1,fsdp=1,sp=2,tp=2", "data=8"):
+        spec = MeshSpec.parse(text)
+        assert MeshSpec.parse(spec.to_str()) == spec
+
+
+# --- grad-accum rescale ------------------------------------------------------
+
+
+def test_elastic_rescale_accum_holds_global_batch():
+    # saved global 16 = batch 2 x 2 devices x accum 4; shrink to 1 device.
+    assert train_mod.elastic_rescale_accum(16, 2, 1) == 8
+    # grow to 4 devices.
+    assert train_mod.elastic_rescale_accum(16, 2, 4) == 2
+    assert train_mod.elastic_rescale_accum(8, 8, 1) == 1
+
+
+def test_elastic_rescale_accum_error_names_nearest_operating_points():
+    with pytest.raises(ValueError) as ei:
+        train_mod.elastic_rescale_accum(8, 3, 1)
+    msg = str(ei.value)
+    # Names the offending values and exact alternative (batch, accum) pairs.
+    assert "global batch 8" in msg and "--batch 3" in msg
+    pairs = re.findall(r"--batch (\d+) --grad_accum_steps (\d+)", msg)
+    assert pairs, msg
+    for b, a in pairs:
+        assert int(b) * int(a) * 1 == 8
+    # When even the device count doesn't divide the global batch, the error
+    # falls back to naming the nearest achievable globals.
+    with pytest.raises(ValueError, match="--grad_accum_steps"):
+        train_mod.elastic_rescale_accum(10, 2, 4)
+
+
+# --- checkpoint world record -------------------------------------------------
+
+
+def test_meta_world_roundtrip_and_legacy():
+    world = {
+        "process_count": 1, "device_count": 2, "mesh": "data=2,fsdp=1,sp=1,tp=1",
+        "global_batch": 8, "grad_accum_steps": 2, "batch": 2,
+        "local_batch": 4, "workers": 1,
+    }
+    meta = ckpt.CheckpointMeta(
+        step=3, epoch=0, batches_in_epoch=3, rng_seed=1, world=world,
+    )
+    assert ckpt.CheckpointMeta.from_json(meta.to_json()).world == world
+    # Pre-elastic meta.json files (no "world" key) still load.
+    legacy = '{"step": 3, "epoch": 0, "batches_in_epoch": 3, "rng_seed": 1}'
+    assert ckpt.CheckpointMeta.from_json(legacy).world is None
+
+
+def test_peek_latest_meta_skips_corrupt_and_handles_empty(tmp_path):
+    assert ckpt.peek_latest_meta(str(tmp_path)) is None
+    assert ckpt.peek_latest_meta(str(tmp_path / "missing")) is None
+
+    # Two legacy-style dirs (meta.json only, no commit markers); the newest
+    # one's meta is returned without touching any arrays.
+    for step, world in ((3, None), (7, {"device_count": 2})):
+        d = tmp_path / f"step_{step:07d}"
+        d.mkdir()
+        meta = ckpt.CheckpointMeta(
+            step=step, epoch=0, batches_in_epoch=step, rng_seed=0, world=world,
+        )
+        (d / "meta.json").write_text(meta.to_json())
+    peeked = ckpt.peek_latest_meta(str(tmp_path))
+    assert peeked.step == 7 and peeked.world == {"device_count": 2}
+
+    # Corrupt the newest meta: peek falls back to the older checkpoint,
+    # mirroring restore's fall-back-past-corrupt behavior.
+    (tmp_path / "step_0000007" / "meta.json").write_text('{"not": "a meta"}')
+    assert ckpt.peek_latest_meta(str(tmp_path)).step == 3
+
+
+# --- data-cursor migration ---------------------------------------------------
+
+
+def _window_counter(windows) -> Counter:
+    return Counter(np.asarray(w).tobytes() for w in windows)
+
+
+def _full_epoch_counter(shard_paths, seq_len, epoch) -> Counter:
+    ds = TokenShardDataset(
+        shard_paths, seq_len=seq_len, process_index=0, process_count=1,
+        num_workers=1,
+    )
+    ds.set_epoch(epoch)
+    return _window_counter(ds.iter_worker(0))
+
+
+def _old_world_consumption(
+    shard_paths, seq_len, epoch, process_count, num_workers, batch_size,
+    consumed_batches,
+) -> Counter:
+    """Ground truth, independent of plan_cursor_migration: replay the actual
+    consumer — per process, worker streams drained batch-by-batch in
+    round-robin order (the DataLoader's schedule) — and collect the windows
+    of the first ``consumed_batches`` batches."""
+    eaten: Counter = Counter()
+    for p in range(process_count):
+        ds = TokenShardDataset(
+            shard_paths, seq_len=seq_len, process_index=p,
+            process_count=process_count, num_workers=num_workers,
+        )
+        ds.set_epoch(epoch)
+        streams = [ds.iter_worker(w) for w in range(num_workers)]
+        remaining = ds.worker_batches(batch_size)
+        taken, w = 0, 0
+        while taken < consumed_batches:
+            if remaining[w] > 0:
+                for _ in range(batch_size):
+                    eaten[np.asarray(next(streams[w])).tobytes()] += 1
+                remaining[w] -= 1
+                taken += 1
+            w = (w + 1) % num_workers
+    return eaten
+
+
+@pytest.mark.parametrize(
+    "old_world,new_world",
+    [
+        # (process_count, workers) old -> new
+        ((2, 2), (1, 1)),   # shrink: 4 loader streams collapse to 1
+        ((1, 1), (2, 2)),   # grow: 1 stream fans out to 4
+        ((2, 1), (1, 2)),   # reshape at equal stream count
+    ],
+)
+def test_cursor_migration_no_window_double_read_or_drop(
+    shard_dir, old_world, new_world
+):
+    """The invariant the whole migration exists for: old-world consumption
+    plus the new world's complement is EXACTLY one full epoch — as multisets
+    of window bytes, so both double-reads and drops are caught."""
+    shard_paths = get_shard_paths(shard_dir, "train")
+    seq_len, epoch, batch, consumed = 32, 0, 4, 10
+    old_p, old_w = old_world
+    new_p, new_w = new_world
+
+    consumed_windows = _old_world_consumption(
+        shard_paths, seq_len, epoch, old_p, old_w, batch, consumed,
+    )
+    plan = plan_cursor_migration(
+        shard_paths, seq_len=seq_len, epoch=epoch,
+        old_process_count=old_p, old_num_workers=old_w,
+        old_batch_size=batch, consumed_batches=consumed,
+    )
+    assert sum(len(v) for v in plan.values()) == old_p * consumed * batch
+
+    complement: Counter = Counter()
+    for p in range(new_p):
+        ds = TokenShardDataset(
+            shard_paths, seq_len=seq_len, process_index=p,
+            process_count=new_p, num_workers=new_w,
+        )
+        ds.set_consumed(plan, epoch=epoch)
+        ds.set_epoch(epoch)
+        for w in range(new_w):
+            complement.update(_window_counter(ds.iter_worker(w)))
+
+    assert consumed_windows + complement == _full_epoch_counter(
+        shard_paths, seq_len, epoch
+    )
+
+
+def test_cursor_migration_equals_prefix_skip_when_world_unchanged(shard_dir):
+    """Same (process, worker) shape on both sides: the consumed plan must be
+    exactly the stream prefix the arithmetic skip would have jumped over, so
+    the migrated resume and the plain resume read identical streams."""
+    shard_paths = get_shard_paths(shard_dir, "train")
+    seq_len, batch, consumed = 32, 4, 7
+    plan = plan_cursor_migration(
+        shard_paths, seq_len=seq_len, epoch=0, old_process_count=1,
+        old_num_workers=1, old_batch_size=batch, consumed_batches=consumed,
+    )
+    ds = TokenShardDataset(
+        shard_paths, seq_len=seq_len, process_index=0, process_count=1,
+        num_workers=1,
+    )
+    ds.set_epoch(0)
+    prefix = [np.asarray(w).copy() for _, w in
+              zip(range(consumed * batch), ds.iter_worker(0))]
+
+    migrated = TokenShardDataset(
+        shard_paths, seq_len=seq_len, process_index=0, process_count=1,
+        num_workers=1,
+    )
+    migrated.set_consumed(plan, epoch=0)
+    migrated.set_epoch(0)
+    skipped = TokenShardDataset(
+        shard_paths, seq_len=seq_len, process_index=0, process_count=1,
+        num_workers=1,
+    )
+    skipped.set_epoch(0)
+    a = _window_counter(migrated.iter_worker(0))
+    b = _window_counter(skipped.iter_worker(0, skip_samples=consumed * batch))
+    assert a == b
+    assert _window_counter(prefix) + a == _full_epoch_counter(
+        shard_paths, seq_len, 0
+    )
+
+
+def test_set_consumed_shrinks_counts_and_clears_on_epoch_change(shard_dir):
+    shard_paths = get_shard_paths(shard_dir, "train")
+    ds = TokenShardDataset(
+        shard_paths, seq_len=32, process_index=0, process_count=1,
+        num_workers=1,
+    )
+    full = ds.batches_per_epoch(4)
+    plan = plan_cursor_migration(
+        shard_paths, seq_len=32, epoch=0, old_process_count=1,
+        old_num_workers=1, old_batch_size=4, consumed_batches=5,
+    )
+    ds.set_consumed(plan, epoch=0)
+    ds.set_epoch(0)
+    assert ds.batches_per_epoch(4) == full - 5
+    # The plan is scoped to its epoch: any other epoch restores full counts.
+    ds.set_epoch(1)
+    assert ds.batches_per_epoch(4) == full
+
+    eval_ds = TokenShardDataset(
+        shard_paths, seq_len=32, process_index=0, process_count=1,
+        num_workers=1, shard_windows=True,
+    )
+    with pytest.raises(ValueError, match="shard-stride"):
+        eval_ds.set_consumed(plan, epoch=0)
+
+
+# --- cross-world restore of shard_update moments -----------------------------
+
+
+def test_shard_update_moments_reshard_across_world_sizes(tmp_path, tiny_config):
+    """Save params + ZeRO-2 data-sharded AdamW moments on a data=8 mesh,
+    restore onto a data=4 mesh: values are bit-exact and every restored leaf
+    lands on the NEW mesh's shardings (the elastic reshard path)."""
+    optimizer = make_optimizer(1e-3)
+    params = gpt2.init_params(tiny_config)
+    mesh8 = create_mesh(MeshSpec(data=8))
+    with activate_mesh(mesh8):
+        p8, o8, _, _ = shard_params_and_opt_state(
+            params, optimizer, mesh8, shard_update=True
+        )
+        # Zeros reshard trivially; make the moments carry real signal first.
+        rng = np.random.default_rng(0)
+        grads = jax.tree_util.tree_map(
+            lambda p: np.asarray(
+                rng.standard_normal(p.shape), dtype=p.dtype
+            ),
+            jax.device_get(p8),
+        )
+        _, o8 = jax.jit(optimizer.update)(grads, o8, p8)
+        meta = ckpt.CheckpointMeta(step=1, epoch=0, batches_in_epoch=1, rng_seed=0)
+        path = ckpt.save_checkpoint(str(tmp_path), 1, p8, o8, meta)
+    saved_o = jax.device_get(o8)
+
+    mesh4 = create_mesh(MeshSpec(data=4))
+    with activate_mesh(mesh4):
+        p4, o4, pshard4, oshard4 = shard_params_and_opt_state(
+            params, optimizer, mesh4, shard_update=True
+        )
+        r_params, r_opt, _ = ckpt.restore_checkpoint(
+            path, p4, o4, pshard4, oshard4
+        )
+    for want, got in zip(
+        jax.tree_util.tree_leaves(saved_o), jax.tree_util.tree_leaves(r_opt)
+    ):
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    for tmpl, got in zip(
+        jax.tree_util.tree_leaves(oshard4), jax.tree_util.tree_leaves(r_opt)
+    ):
+        assert got.sharding == tmpl, (got.sharding, tmpl)
+
+
+# --- end-to-end: save at world size 2, resume at world size 1 ----------------
+
+
+def _per_step_losses(printed: list[float]) -> list[float]:
+    """Invert the tracker's running window mean (deque maxlen 50, AVERAGE;
+    never reset mid-run, restarted empty on resume): with --cli_every 1 and
+    n <= 50 prints, printed[n-1] = mean(loss[0..n-1]), so
+    loss[n-1] = n*printed[n-1] - (n-1)*printed[n-2]."""
+    out = []
+    for n, p in enumerate(printed, start=1):
+        out.append(n * p - (n - 1) * printed[n - 2] if n > 1 else p)
+    return out
+
+
+def test_cli_elastic_resume_shrink_matches_uninterrupted_run(
+    capsys, shard_dir, tmp_path
+):
+    """The acceptance proof: a run saved at world size 2 (data=2) resumes at
+    world size 1 via --inject_world_size, grad-accum is rescaled 2 -> 4 to
+    hold the global batch at 8, the data cursor migrates, and steps 4-6 land
+    on the same losses as a run that never resized. --dropout 0 because
+    dropout masks are position-dependent in the [accum, batch, seq] layout,
+    which differs across arrangements of the same 8-window global batch."""
+    common = [
+        "--data_dir", shard_dir,
+        "--n_layer", "2", "--n_embd", "32", "--n_head", "2",
+        "--vocab_size", "257", "--seq_len", "32", "--batch", "2",
+        "--workers", "1", "--dropout", "0", "--lr", "1e-3",
+        "--cli_every", "1",
+    ]
+    save_dir = str(tmp_path / "ckpt")
+
+    # Reference trajectory: 6 uninterrupted steps at world size 1.
+    out_a = run_cli(
+        capsys, *common, "--mesh", "data=1", "--grad_accum_steps", "4",
+        "--max_steps", "6",
+    )
+    ref = _per_step_losses(losses_from(out_a))
+    assert len(ref) == 6
+
+    # Interrupted run: 3 steps at world size 2 (global batch 2x2x2 = 8).
+    out_b = run_cli(
+        capsys, *common, "--mesh", "data=2", "--grad_accum_steps", "2",
+        "--max_steps", "3", "--save_every", "3", "--save_dir", save_dir,
+    )
+    assert "training done: 3 optimizer steps" in out_b
+
+    # Before resuming for real: the loud operating-point error. A --batch the
+    # saved global batch can't be rebuilt from must name the nearest valid
+    # pairs, not train on a silently different batch. (Probed before run C,
+    # whose own final checkpoint records the post-resize world.)
+    i = common.index("--batch")
+    bad = common[:i] + ["--batch", "3"] + common[i + 2:]
+    with pytest.raises(SystemExit) as ei:
+        run_cli(
+            capsys, *bad, "--mesh", "data=2", "--grad_accum_steps", "2",
+            "--max_steps", "6", "--save_dir", save_dir, "--resume",
+            "--inject_world_size", "1",
+        )
+    msg = str(ei.value)
+    assert "elastic resume" in msg and "--batch" in msg
+    capsys.readouterr()
+
+    # Elastic resume: the observed world shrank to 1 device.
+    out_c = run_cli(
+        capsys, *common, "--mesh", "data=2", "--grad_accum_steps", "2",
+        "--max_steps", "6", "--save_dir", save_dir, "--resume",
+        "--inject_world_size", "1",
+    )
+    assert "[elastic] world resized: 2 -> 1 device(s)" in out_c
+    assert "--grad_accum_steps 2 -> 4 holds the global batch at 8" in out_c
+    assert "[elastic] data cursor migrated" in out_c
+    assert "resumed from" in out_c and "step 3" in out_c
+    assert "training done: 6 optimizer steps" in out_c
+
+    resumed = _per_step_losses(losses_from(out_c))
+    assert len(resumed) == 3
+    # Bit-identity is impossible across mesh arrangements (psum/accumulation
+    # reduction orders differ); under fp32-highest matmuls the real gap is
+    # ~1e-6, so 2e-3 separates "same trajectory" from "different data/batch".
+    np.testing.assert_allclose(resumed, ref[3:], atol=2e-3, rtol=0)
+
+
+def test_cli_inject_world_size_requires_resume(capsys, shard_dir):
+    with pytest.raises(SystemExit):
+        run_cli(
+            capsys, "--data_dir", shard_dir, "--inject_world_size", "4",
+            "--max_steps", "1",
+        )
+    capsys.readouterr()

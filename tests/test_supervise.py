@@ -168,6 +168,87 @@ def test_supervise_data_abort_rc171_burns_attempt(tmp_path):
     assert "giving up after 0 restarts" in r.stderr
 
 
+def test_supervise_elastic_shrink_and_retry(tmp_path):
+    # Elastic shrink-and-retry: repeated rc-143 preemptions with
+    # ELASTIC_HOSTS_CMD set probe the live host count and relaunch the
+    # survivors with WORLD_SIZE shrunk — without burning a MAX_RESTARTS
+    # attempt (proven by MAX_RESTARTS=0). The stub "trainer" keeps exiting
+    # 143 while WORLD_SIZE=2 and succeeds once relaunched at WORLD_SIZE=1.
+    script = tmp_path / "fake_train.sh"
+    script.write_text(
+        "#!/usr/bin/env bash\n"
+        'if [ "${WORLD_SIZE:-}" = "1" ]; then exit 0; fi\n'
+        "exit 143\n"
+    )
+    script.chmod(0o755)
+    env = _env("0")
+    env["WORLD_SIZE"] = "2"
+    env["ELASTIC_HOSTS_CMD"] = "echo 1"
+    env["ELASTIC_SHRINK_AFTER"] = "2"
+    r = subprocess.run(
+        ["bash", SUPERVISE, "bash", str(script)], env=env,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    # Two preemptions at full size (SHRINK_AFTER=2), then the shrink.
+    assert r.stderr.count("preempted (rc=143)") == 2
+    assert "attempt counter unchanged: 0/0" in r.stderr
+    assert "elastic shrink: 2 -> 1 host(s)" in r.stderr
+    assert "does not count against MAX_RESTARTS" in r.stderr
+    assert "giving up" not in r.stderr
+
+
+def test_supervise_elastic_min_hosts_floor(tmp_path):
+    # ELASTIC_MIN_HOSTS is the floor: when the probe reports fewer live
+    # hosts, the wrapper refuses to shrink and gives up with the preemption
+    # rc instead of relaunching a world too small to be worth training.
+    script = tmp_path / "fake_train.sh"
+    script.write_text("#!/usr/bin/env bash\nexit 143\n")
+    script.chmod(0o755)
+    env = _env("0")
+    env["WORLD_SIZE"] = "4"
+    env["ELASTIC_HOSTS_CMD"] = "echo 1"
+    env["ELASTIC_MIN_HOSTS"] = "2"
+    env["ELASTIC_SHRINK_AFTER"] = "1"
+    r = subprocess.run(
+        ["bash", SUPERVISE, "bash", str(script)], env=env,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 143, (r.stdout, r.stderr)
+    assert "below ELASTIC_MIN_HOSTS=2" in r.stderr
+    assert "refusing to shrink further" in r.stderr
+    assert "elastic shrink:" not in r.stderr
+
+
+def test_supervise_elastic_probe_failure_keeps_retrying(tmp_path):
+    # A failing/garbage ELASTIC_HOSTS_CMD must not shrink or crash the
+    # wrapper — the preemption keeps retrying at full size as if elastic
+    # were off. The stub exits 143 twice, then succeeds.
+    marker = tmp_path / "preempts"
+    script = tmp_path / "fake_train.sh"
+    script.write_text(
+        "#!/usr/bin/env bash\n"
+        f'n=$(ls "{marker}".* 2>/dev/null | wc -l)\n'
+        'if [ "$n" -lt 2 ]; then\n'
+        f'  touch "{marker}.$n"\n'
+        "  exit 143\n"
+        "fi\n"
+        "exit 0\n"
+    )
+    script.chmod(0o755)
+    env = _env("0")
+    env["WORLD_SIZE"] = "2"
+    env["ELASTIC_HOSTS_CMD"] = "echo not-a-number"
+    env["ELASTIC_SHRINK_AFTER"] = "1"
+    r = subprocess.run(
+        ["bash", SUPERVISE, "bash", str(script)], env=env,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert r.stderr.count("preempted (rc=143)") == 2
+    assert "elastic shrink:" not in r.stderr and "giving up" not in r.stderr
+
+
 def test_supervise_preempt_nan_grand_e2e(shard_dir, tmp_path):
     """The full resilience story through the wrapper: a NaN-poisoned step is
     skipped in place (guard), a SIGTERM preemption emergency-saves and exits
