@@ -277,6 +277,30 @@ class ServeConfig:
     * ``eos_id`` — generation stops (and the slot + blocks are reclaimed)
       when this token is sampled; None = run every request to its
       max_new_tokens.
+
+    Scheduler policy knobs (all default to the PR 7 behavior):
+
+    * ``prefill_chunk`` — 0 = whole-prompt prefill at admission (one compile
+      per prompt-length bucket). > 0 = chunked prefill: prompts advance one
+      ``prefill_chunk``-token slice per engine step, interleaved with decode
+      steps, so a long prompt no longer freezes every in-flight stream's
+      inter-token latency. The chunk width is part of the compile
+      signature — one chunk-prefill compile total, regardless of prompt
+      lengths.
+    * ``prefix_cache`` — hash-cons full KV blocks by token-prefix so
+      requests sharing a system prompt skip prefill for the cached span.
+      Entries are refcounted in the BlockAllocator; the partial tail block
+      is copy-on-write.
+    * ``admission`` — block-grant policy. ``"reserve"`` (PR 7): admission
+      allocates the worst-case ``ceil((P + max_new - 1) / block_size)``
+      blocks up front, all-or-nothing. ``"watermark"``: admission grants
+      only the blocks the prompt needs now, as long as ``watermark_blocks``
+      blocks stay free; decode grows tables lazily and, on pool
+      exhaustion, preempts the newest-admitted request (blocks freed,
+      request requeued with its generated tokens as recompute-prefill)
+      instead of head-of-line blocking.
+    * ``watermark_blocks`` — free-block floor the watermark admission
+      keeps as decode-growth headroom.
     """
 
     max_batch: int = 8
@@ -284,6 +308,10 @@ class ServeConfig:
     num_blocks: int = 256
     attn_impl: str = "auto"
     eos_id: int | None = None
+    prefill_chunk: int = 0
+    prefix_cache: bool = False
+    admission: str = "reserve"
+    watermark_blocks: int = 1
 
     def __post_init__(self) -> None:
         if self.max_batch < 1:
@@ -302,6 +330,20 @@ class ServeConfig:
             )
         if self.eos_id is not None and self.eos_id < 0:
             raise ValueError(f"eos_id={self.eos_id} must be >= 0")
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk={self.prefill_chunk} must be >= 0 "
+                f"(0 disables chunking)"
+            )
+        if self.admission not in ("reserve", "watermark"):
+            raise ValueError(
+                f"admission={self.admission!r}: expected 'reserve' or "
+                f"'watermark'"
+            )
+        if self.watermark_blocks < 0:
+            raise ValueError(
+                f"watermark_blocks={self.watermark_blocks} must be >= 0"
+            )
 
     def max_blocks_per_seq(self, n_positions: int) -> int:
         """Static block-table width: enough blocks for a full-context

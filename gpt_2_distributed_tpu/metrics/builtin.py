@@ -211,6 +211,27 @@ def collect_memory(tracker: "StatsTracker") -> dict[str, float]:
     return out
 
 
+# --- serving-load metrics (pushed by serving/serve.py's --tb_dir sink) -----
+# TB-only (cli_format None): the serving CLI's stderr summary already
+# narrates totals; these exist so a deployment's TensorBoard sees load —
+# queue depth/wait and occupancy size the deployment, preemption count and
+# prefix-hit volume judge the ServeConfig scheduler knobs. All CURRENT:
+# each flush pushes the engine's metrics_snapshot() as-of-now (wait is a
+# running mean, preempted/prefix tokens are cumulative counters).
+
+for _name in (
+    "queue_wait_ms",          # mean enqueue->admission gap per admission
+    "preempted",              # cumulative pool-pressure swap-outs
+    "prefix_cached_tokens",   # cumulative prompt tokens served from cache
+    "serve_queue_depth",      # requests waiting for a slot, as of the flush
+    "serve_occupancy",        # occupied decode slots, as of the flush
+):
+    METRIC_REGISTRY.metric(
+        _name, reduction=ReductionStrategy.CURRENT, tb_prefix="serve/",
+        cli_format=None,
+    )(float)
+
+
 for _name, _red, _fmt in (
     ("device_alloc_gb", ReductionStrategy.AVERAGE, "hbm: {value:.2f}GB"),
     ("device_limit_gb", ReductionStrategy.CURRENT, None),

@@ -94,6 +94,60 @@ def paged_attention_xla(
     return o[:, :, 0]                                # [B, H, D]
 
 
+def paged_prefill_attention(
+    q: jnp.ndarray,            # [B, T, H, D] chunk queries, compute dtype
+    k_pool: jnp.ndarray,       # [N, H, bs, D]
+    v_pool: jnp.ndarray,       # [N, H, bs, D]
+    block_table: jnp.ndarray,  # [B, M] int32 pool indices
+    start: jnp.ndarray,        # [B] int32 absolute position of q[:, 0]
+) -> jnp.ndarray:
+    """Chunked-prefill attention over a partially-built block table.
+
+    Query ``t`` of sequence ``b`` sits at absolute position
+    ``start[b] + t`` and attends causally over the table's contiguous
+    view — all earlier positions (prior chunks and prefix-cache hits
+    already scattered into pool blocks) plus the current chunk's own
+    K/V, which the caller must have scattered before this call.
+
+    Mirrors the dense prefill path (``ops/attention.py::
+    causal_attention_bthd``) op-for-op on the attendable region —
+    identical einsum forms, fp32 scores with the scale applied after,
+    ``MASK_VALUE`` fill, fp32 softmax, probs cast back — so on the
+    dense-prefill path (CPU "auto"/"xla") chunked prefill is bit-identical
+    to whole-prompt prefill for any chunk split. Positions past the causal
+    frontier read whatever the pool holds (stale blocks, later rows of a
+    partially-filled tail block): MASK_VALUE's post-max-subtract underflow
+    zeroes them exactly — the same masked-width invariance
+    ``paged_attention_xla`` already relies on.
+
+    XLA gather only: prefill is compute-bound (the O(T·S) score matmul
+    dominates the gathered-copy traffic), so the Pallas scalar-prefetch
+    treatment that pays off for single-row decode is left to the on-chip
+    campaign.
+    """
+    b, t, h, d = q.shape
+    m = block_table.shape[1]
+    bs = k_pool.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    # [B, M, H, bs, D] -> [B, H, M*bs, D]: contiguous per-sequence view.
+    kc = k_pool[block_table].transpose(0, 2, 1, 3, 4).reshape(b, h, m * bs, d)
+    vc = v_pool[block_table].transpose(0, 2, 1, 3, 4).reshape(b, h, m * bs, d)
+
+    qh = q.transpose(0, 2, 1, 3)                     # [B, H, T, D]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", qh, kc, preferred_element_type=jnp.float32
+    ) * scale                                        # [B, H, T, M*bs] fp32
+    qpos = start[:, None, None, None] + jax.lax.broadcasted_iota(
+        jnp.int32, (b, 1, t, 1), 2
+    )
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1, m * bs), 3)
+    scores = jnp.where(kpos <= qpos, scores, MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", probs, vc)     # [B, H, T, D]
+    return o.transpose(0, 2, 1, 3)                   # [B, T, H, D]
+
+
 def _paged_fwd_kernel(
     bt_ref,       # scalar prefetch: [B, M] int32 block table
     len_ref,      # scalar prefetch: [B] int32 lengths

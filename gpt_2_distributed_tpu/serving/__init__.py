@@ -5,17 +5,19 @@ this package is what turns it into something that serves *traffic*:
 
 * ``paged_cache`` — fixed-size KV blocks in one preallocated device buffer
   plus a host-side free-list allocator and per-sequence block tables.
-* ``engine`` — the continuous-batching engine: prefill/decode phase split,
-  admission of queued requests into the in-flight decode batch at step
-  boundaries, eviction on EOS/max-len, streaming per-token output. The
-  decode step is ONE compiled program per ``ServeConfig`` signature.
+* ``engine`` — the continuous-batching engine: prefill/decode phase split
+  (whole-prompt or chunked prefill), FIFO admission with reserve- or
+  watermark-based block grants, prefix-cache reuse of shared prompt
+  blocks, vLLM-style preemption/recompute under pool pressure, eviction
+  on EOS/max-len, streaming per-token output. The decode step is ONE
+  compiled program per ``ServeConfig`` signature.
 * ``serve`` — the CLI entry point (``gpt2-tpu-serve``).
 
-The paged attention op itself lives with the other kernels
+The paged attention ops themselves live with the other kernels
 (``ops/paged_attention.py``).
 """
 
 from gpt_2_distributed_tpu.serving.engine import RequestHandle, ServingEngine
-from gpt_2_distributed_tpu.serving.paged_cache import BlockAllocator
+from gpt_2_distributed_tpu.serving.paged_cache import BlockAllocator, PrefixCache
 
-__all__ = ["BlockAllocator", "RequestHandle", "ServingEngine"]
+__all__ = ["BlockAllocator", "PrefixCache", "RequestHandle", "ServingEngine"]

@@ -7,36 +7,64 @@ the speed of its longest member while finished rows burn flops. This engine
 is the serving-shaped alternative:
 
 * **Prefill/decode split per request.** Each admitted request runs its
-  prompt through ``decode.prefill`` once (jitted per prompt-length *bucket*
-  — lengths round up to a block multiple, so the compile-signature set is
-  small and bounded), samples its first token, and scatters its K/V into
-  pool blocks. From then on it only ever costs one row of the decode step.
+  prompt through a prefill step (whole-prompt by default, jitted per
+  prompt-length *bucket*; or fixed-width chunks — see below), samples its
+  first token, and lands its K/V in pool blocks. From then on it only ever
+  costs one row of the decode step.
 * **One decode step, compiled once.** The step's signature is fixed by
   ``ServeConfig`` — ``[max_batch]`` token/position/key rows, the
   ``[num_blocks, ...]`` pools, the ``[max_batch, M]`` block table — so
   admissions and evictions are pure *data* changes. ``tests/test_serving.py``
   asserts ``_cache_size() == 1`` across a full churn of arrivals and exits.
-* **Admission at step boundaries.** A FIFO queue feeds free slots; a request
-  is admitted only when the allocator can cover its *worst-case* block need
-  (``ceil((P + max_new - 1) / block_size)`` — the final sampled token is
-  emitted but never processed, so its position is never written), which
-  means an in-flight request can never OOM mid-decode. Head-of-line order
-  is preserved: if the head doesn't fit, nothing behind it jumps the queue.
-* **Eviction on EOS / max-len** releases the request's blocks and zeroes its
-  block-table row (back to the null block), leaving the slot free for the
-  next admission. Idle rows keep flowing through the compiled step with
-  ``length 0`` — the paged-attention mask makes them exact no-ops.
+* **Chunked prefill** (``ServeConfig.prefill_chunk > 0``): prompts advance
+  one fixed-width chunk per engine step, interleaved with decode steps, so
+  a long prompt no longer freezes every in-flight stream's inter-token
+  latency. The chunk scatters its K/V into the request's pool blocks at
+  position granularity and attends over the partially-built table
+  (``ops/paged_attention.py::paged_prefill_attention``); the fixed chunk
+  width makes it ONE compile regardless of prompt lengths.
+* **Prefix caching** (``ServeConfig.prefix_cache``): full prompt blocks are
+  hash-consed by token-prefix (``paged_cache.PrefixCache``) with refcounted
+  pool blocks, so requests sharing a system prompt skip prefill for the
+  cached span — admission retains the cached blocks into the request's
+  table and prefill starts at the first uncached position. A prompt ending
+  exactly on a cached block boundary copy-on-writes that block (the last
+  prompt position must be recomputed for its logits, and the recompute
+  scatters into the request's private copy, never the shared block).
+* **Admission at step boundaries.** A FIFO queue feeds free slots. Policy
+  ``"reserve"`` (default) grants the *worst-case* block need
+  (``ceil((P + max_new - 1) / block_size)``) all-or-nothing, so an
+  in-flight request can never OOM mid-decode. Policy ``"watermark"``
+  grants only what the prompt needs now (keeping ``watermark_blocks``
+  free as growth headroom), grows tables lazily each decode step, and on
+  pool exhaustion **preempts** the newest-admitted request — its blocks
+  are freed and it requeues at the head with its generated tokens as a
+  recompute-prefill — instead of head-of-line blocking. The oldest
+  request is never preempted, so the engine always makes forward
+  progress. Head-of-line order is preserved in both policies: if the
+  head doesn't fit, nothing behind it jumps the queue.
+* **Eviction on EOS / max-len** releases the request's blocks (shared
+  blocks just drop a reference; the prefix cache keeps them) and zeroes
+  its block-table row, leaving the slot free for the next admission. Idle
+  rows keep flowing through the compiled step with ``length 0`` — the
+  paged-attention mask makes them exact no-ops.
 * **Streaming**: every sampled token is pushed through the request's
-  ``on_token`` callback the step it is produced, including the
-  prefill-sampled first token (which is what TTFT measures).
+  ``on_token`` callback the step it is produced. A preempted request's
+  resume never re-emits: its last sampled token is carried as the pending
+  decode input, so TTFT reflects first emission, not re-admission.
 
 Exactness contract: with ``attn_impl="xla"`` on CPU, each request's token
 stream is bit-identical to ``generate_cached(batch=1, prompt, rng=request
 key)`` — greedy AND seeded sampling — for ANY interleaving of other
-requests. The decode step mirrors ``decode.decode_step`` op-for-op; rows
-are independent in every op (batch is a parallel dim throughout), and each
-slot carries its own PRNG chain in the exact split order of the one-shot
-scan. ``tests/test_serving.py`` enforces this.
+requests, ANY ``prefill_chunk``, prefix-cache hits, and preemptions. The
+decode step mirrors ``decode.decode_step`` op-for-op and the chunked
+prefill mirrors the dense prefill op-for-op on the attendable region; rows
+are independent in every op, each slot carries its own PRNG chain in the
+exact split order of the one-shot scan, preemption saves the chain head
+and recompute-prefill restores it without resampling, and cached K/V
+blocks hold exactly the bits prefill would have recomputed (K/V at
+position i is a pure function of tokens[0..i]). ``tests/test_serving.py``
+enforces all of it.
 """
 
 from __future__ import annotations
@@ -57,9 +85,14 @@ from gpt_2_distributed_tpu.models.generate import (
     sample_token,
 )
 from gpt_2_distributed_tpu.ops.layers import layer_norm
-from gpt_2_distributed_tpu.ops.paged_attention import paged_attention
+from gpt_2_distributed_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_prefill_attention,
+)
 from gpt_2_distributed_tpu.serving.paged_cache import (
     BlockAllocator,
+    PrefixCache,
+    copy_block,
     init_pools,
     scatter_prefill,
 )
@@ -67,7 +100,8 @@ from gpt_2_distributed_tpu.serving.paged_cache import (
 
 class RequestHandle:
     """One submitted request: its prompt, its growing output, and the
-    timestamps the bench reads (submit / first token / finish)."""
+    accounting the bench and the serving CLI read (timestamps, queue wait,
+    preemption/resume counts, prefix-cache hits)."""
 
     def __init__(
         self,
@@ -86,9 +120,18 @@ class RequestHandle:
         self.submit_time: float | None = None
         self.first_token_time: float | None = None
         self.finish_time: float | None = None
+        self.queue_wait_ms = 0.0     # cumulative: every (re)queue -> admit gap
+        self.preemptions = 0         # times swapped out for pool pressure
+        self.resumes = 0             # re-admissions after a preemption
+        self.prefix_cached_tokens = 0  # prompt tokens skipped at 1st admission
         self._key = None        # [2] uint32 PRNG chain head
         self._slot: int | None = None
         self._blocks: list[int] | None = None
+        self._enqueue_time: float | None = None
+        self._admit_order = -1       # monotone per admission; newest = victim
+        self._work: np.ndarray | None = None  # tokens this admission prefills
+        self._prefill_pos: int | None = None  # next work position; None = done
+        self._pending_token: int | None = None  # resume: decode input, no emit
 
     @property
     def tokens(self) -> list[int]:
@@ -119,7 +162,7 @@ def _prefill_impl(
     top_k: int | None,
     compute_dtype,
 ):
-    """Prompt forward + first-token sample for one request.
+    """Whole-prompt forward + first-token sample for one request.
 
     Compiles once per (Pf, pad_to) bucket, NOT per prompt length: the true
     length arrives as a traced scalar and only feeds a dynamic_slice. The
@@ -152,6 +195,92 @@ def _prefill_impl(
     return first, key, k, v
 
 
+def _chunk_prefill_impl(
+    params,
+    k_pool: jnp.ndarray,       # [L, N, H, bs, D] — donated
+    v_pool: jnp.ndarray,
+    bt_row: jnp.ndarray,       # [M] int32 — this request's block-table row
+    chunk: jnp.ndarray,        # [1, C] int32 tokens, right-padded
+    start: jnp.ndarray,        # scalar int32 — work position of chunk[0, 0]
+    clen: jnp.ndarray,         # scalar int32 — real tokens in this chunk
+    key: jnp.ndarray,          # [2] uint32
+    *,
+    config: GPT2Config,
+    temperature: float,
+    top_k: int | None,
+):
+    """One prefill chunk straight into the pool: compute K/V for positions
+    ``[start, start + clen)``, scatter them into the request's blocks at
+    position granularity, attend over the partially-built table.
+
+    Compiles once per chunk width C (shape-keyed) — in chunked mode C is
+    ``ServeConfig.prefill_chunk`` for every prompt, so one compile total.
+    The whole-prompt continuation path (``prefill_chunk=0``) buckets C to
+    a block multiple like ``_prefill_impl`` does for prefix-cache hits
+    (remainder bounded by the prompt), and uses the full table width
+    ``M * bs`` for preemption resumes (remainder grows with generation —
+    one program covers every resume length).
+
+    Bit-parity: every op mirrors the dense prefill path
+    (``decode.prefill`` → ``causal_attention_bthd``) per position —
+    identical embedding gathers, sublayer math, einsum forms, masked fp32
+    softmax — so for the dense-prefill configurations (the exactness
+    contract's scope) any chunk split reproduces whole-prompt prefill
+    bit-for-bit. Padded rows (``i >= clen``) are dropped from the scatter
+    (out-of-range destination) and causally masked out of every row we
+    read. Every chunk samples a token with the request key — one compiled
+    program — and the host discards it on non-final chunks, leaving the
+    PRNG chain's one split exactly where ``generate_cached`` puts it.
+
+    Returns (sampled token at position start+clen-1, advanced key, pools).
+    """
+    c = chunk.shape[1]
+    n = k_pool.shape[1]
+    bs = k_pool.shape[3]
+    dtype = k_pool.dtype
+    start = jnp.asarray(start, jnp.int32)
+    clen = jnp.asarray(clen, jnp.int32)
+
+    tok = params["wte"].astype(dtype).at[chunk].get(mode="clip")  # [1, C, E]
+    pos_ids = start + jax.lax.iota(jnp.int32, c)                  # [C]
+    # Gather (not dynamic_slice): a straddling final chunk has pos_ids past
+    # n_positions-1 on its padded rows; clip freezes THOSE rows only, where
+    # dynamic_slice would clamp the start and shift every real position.
+    wpe = params["wpe"].astype(dtype).at[pos_ids].get(mode="clip")  # [C, E]
+    x = tok + wpe[None]
+
+    valid = jax.lax.iota(jnp.int32, c) < clen                     # [C]
+    blk = bt_row.at[pos_ids // bs].get(mode="clip")
+    blk = jnp.where(valid, blk, n)   # out-of-range => scatter drops the row
+    off = pos_ids % bs
+
+    def body(x, layer):
+        bp, kp, vp = layer           # kp/vp: [N, H, bs, D]
+        y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
+        q, k, v = gpt2.qkv_proj(config, y, bp)                    # [1, C, H, D]
+        kp = kp.at[blk, :, off].set(k[0].astype(kp.dtype), mode="drop")
+        vp = vp.at[blk, :, off].set(v[0].astype(vp.dtype), mode="drop")
+        o = paged_prefill_attention(
+            q, kp, vp, bt_row[None], start[None]
+        )                                                          # [1, C, H, D]
+        o = o.reshape(1, c, config.n_embd)
+        o = o @ bp["attn_proj_w"].astype(x.dtype) + bp["attn_proj_b"].astype(x.dtype)
+        x = x + o
+        x = gpt2._mlp_sublayer(config, x, bp, None, True)
+        return x, (kp, vp)
+
+    x, (kps, vps) = jax.lax.scan(body, x, (params["block"], k_pool, v_pool))
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
+    h_last = jax.lax.dynamic_slice_in_dim(x, clen - 1, 1, axis=1)[:, 0]
+    logits0 = jnp.einsum(
+        "bc,vc->bv", h_last, params["wte"].astype(h_last.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    key, sub = jax.random.split(key)
+    first = sample_token(logits0, sub, temperature, top_k)[0]
+    return first, key, kps, vps
+
+
 def _decode_step_impl(
     params,
     k_pool: jnp.ndarray,       # [L, N, H, bs, D]
@@ -173,9 +302,9 @@ def _decode_step_impl(
     Mirrors ``decode.decode_step`` op-for-op (same embedding gathers, same
     einsum forms, per-position sublayers) with two generalizations: `pos`
     is per-row instead of a shared scalar, and the cache indexing goes
-    through the block table. Inactive rows are steered to the null block
-    and a zero attention length — their lanes compute garbage that nothing
-    reads.
+    through the block table. Inactive rows (idle slots AND slots still in
+    chunked prefill) are steered to the null block and a zero attention
+    length — their lanes compute garbage that nothing reads.
     """
     bsz = tokens.shape[0]
     dtype = k_pool.dtype
@@ -259,16 +388,17 @@ class ServingEngine:
         self.top_k = top_k
         self.compute_dtype = compute_dtype
 
-        m = serve.max_blocks_per_seq(config.n_positions)
+        self._m = serve.max_blocks_per_seq(config.n_positions)
         self.k_pool, self.v_pool = init_pools(config, serve, compute_dtype)
         self.allocator = BlockAllocator(serve.num_blocks)
+        self._cache = PrefixCache(serve.block_size) if serve.prefix_cache else None
         # Scheduler state lives on the HOST as numpy: admission/eviction
         # mutate it in place for free, and the arrays ship to the compiled
         # step with each call (a few hundred bytes). jnp `.at[].set` outside
         # jit costs ~1-2 ms PER UPDATE in op-by-op dispatch — doing the
         # bookkeeping device-side made admission 6x slower than the prefill
         # it wraps.
-        self.block_table = np.zeros((serve.max_batch, m), np.int32)
+        self.block_table = np.zeros((serve.max_batch, self._m), np.int32)
         self.pos = np.zeros((serve.max_batch,), np.int32)
         self.tokens = np.zeros((serve.max_batch,), np.int32)
         self.active = np.zeros((serve.max_batch,), bool)
@@ -277,14 +407,19 @@ class ServingEngine:
         self._slots: list[RequestHandle | None] = [None] * serve.max_batch
         self._queue: collections.deque[RequestHandle] = collections.deque()
         self._next_id = 0
+        self._admit_seq = 0
         self.stats = {
-            "admitted": 0, "finished": 0, "prefills": 0,
+            "admitted": 0, "finished": 0, "prefills": 0, "prefill_chunks": 0,
             "decode_steps": 0, "tokens_out": 0,
+            "preemptions": 0, "resumes": 0,
+            "prefix_hit_tokens": 0, "cow_copies": 0,
+            "prefill_ms": 0.0, "decode_ms": 0.0, "queue_wait_ms": 0.0,
         }
 
         # Per-engine jits so tests can count THIS engine's compilations:
         # the no-retrace contract is `_decode_fn._cache_size() == 1` across
-        # arbitrary admission/eviction churn.
+        # arbitrary admission/eviction churn, and `_chunk_fn._cache_size()
+        # == 1` in chunked mode (the chunk width is fixed).
         self._decode_fn = jax.jit(
             functools.partial(
                 _decode_step_impl, config=config,
@@ -301,12 +436,21 @@ class ServingEngine:
             ),
             static_argnames=("pad_to",),
         )
+        self._chunk_fn = jax.jit(
+            functools.partial(
+                _chunk_prefill_impl, config=config,
+                temperature=self.temperature, top_k=top_k,
+            ),
+            donate_argnames=("k_pool", "v_pool"),
+        )
 
     # ------------------------------------------------------------- intake
 
     def _blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
         # Positions 0 .. P+max_new-2 get written (the last sampled token is
-        # emitted but never processed); worst case ignores early EOS.
+        # emitted but never processed); worst case ignores early EOS. The
+        # formula is invariant under preemption: a resumed request's work
+        # prompt plus its remaining tokens end at the same last position.
         return -(-(prompt_len + max_new_tokens - 1) // self.serve.block_size)
 
     def submit(
@@ -339,125 +483,382 @@ class ServingEngine:
         self._next_id += 1
         req._key = np.asarray(rng, np.uint32)
         req.submit_time = time.monotonic()
+        req._enqueue_time = req.submit_time
         self._queue.append(req)
         return req
+
+    def _alloc_blocks(self, n: int, floor: int) -> list[int] | None:
+        """n blocks while leaving `floor` free, evicting unpinned
+        prefix-cache entries (LRU) under pressure."""
+        while True:
+            if self.allocator.available >= n + floor:
+                return self.allocator.alloc(n) if n else []
+            if self._cache is None or not self._cache.evict_one(self.allocator):
+                return None
+
+    def _admit_one(self, slot: int, req: RequestHandle) -> bool:
+        """Try to place the queue head into `slot`: prefix-cache lookup,
+        block grant (reserve or watermark policy), COW of an
+        aligned-cached tail, then prefill (inline for whole-prompt mode,
+        deferred to ``_prefill_tick`` for chunked mode)."""
+        bs = self.serve.block_size
+        resuming = req._pending_token is not None
+        work = np.asarray(
+            req.prompt + (req.generated[:-1] if req.generated else []),
+            np.int32,
+        )
+        p_work = len(work)
+        need_total = self._blocks_needed(len(req.prompt), req.max_new_tokens)
+
+        shared: list[int] = []
+        cow_src: int | None = None
+        s0 = 0
+        if self._cache is not None:
+            hits = self._cache.lookup(work)
+            if hits and len(hits) * bs == p_work:
+                # Whole prompt cached and block-aligned: the final block
+                # must be private (position p_work-1 is recomputed for its
+                # logits and scattered back) — copy-on-write it.
+                cow_src = hits.pop()
+                s0 = p_work - 1
+            else:
+                s0 = len(hits) * bs
+            shared = hits
+            # Pin everything we plan to reuse BEFORE allocating: the
+            # allocator may evict cache entries under pressure, and an
+            # unpinned hit (refcount 1) is exactly what it would take.
+            for b in shared:
+                self.allocator.retain(b)
+            if cow_src is not None:
+                self.allocator.retain(cow_src)
+
+        n_shared = len(shared)
+        if self.serve.admission == "watermark":
+            now_blocks = min(-(-(p_work + 1) // bs), need_total)
+            n_alloc = now_blocks - n_shared
+            floor = self.serve.watermark_blocks if self._has_active() else 0
+        else:
+            n_alloc = need_total - n_shared
+            floor = 0
+        ids = self._alloc_blocks(max(n_alloc, 0), floor)
+        if ids is None:
+            for b in shared:        # unwind the pins; head waits its turn
+                self.allocator.release([b])
+            if cow_src is not None:
+                self.allocator.release([cow_src])
+            return False
+
+        if cow_src is not None:
+            dst = ids[0]            # block index n_shared — the prompt tail
+            self.k_pool, self.v_pool = copy_block(
+                self.k_pool, self.v_pool, np.int32(cow_src), np.int32(dst)
+            )
+            self.allocator.release([cow_src])   # drop the copy-window pin
+            self.stats["cow_copies"] += 1
+
+        now = time.monotonic()
+        req.queue_wait_ms += (now - req._enqueue_time) * 1e3
+        self.stats["queue_wait_ms"] += (now - req._enqueue_time) * 1e3
+        req._admit_order = self._admit_seq
+        self._admit_seq += 1
+        self.stats["admitted"] += 1
+        if resuming or (req.generated and req._pending_token is None):
+            req.resumes += 1
+            self.stats["resumes"] += 1
+        if s0:
+            self.stats["prefix_hit_tokens"] += s0
+            if not req.generated:
+                req.prefix_cached_tokens = s0
+
+        blocks = shared + ids
+        req._slot, req._blocks = slot, blocks
+        req._work, req._prefill_pos = work, s0
+        self._slots[slot] = req
+        self.block_table[slot, :] = 0
+        self.block_table[slot, :len(blocks)] = blocks
+        self.pos[slot] = 0
+        self.active[slot] = False
+
+        if self.serve.prefill_chunk == 0:
+            # Whole-prompt mode: prefill completes inside admission (the
+            # PR 7 contract — TTFT pays the full prompt forward here).
+            if s0 == 0 and not resuming:
+                self._prefill_whole(slot, req)
+            else:
+                while self._slots[slot] is req and req._prefill_pos is not None:
+                    self._prefill_step(slot, req)
+        return True
 
     def _try_admit(self) -> int:
         """Admit queued requests into free slots, FIFO, while blocks last."""
         admitted = 0
-        bs = self.serve.block_size
         while self._queue:
             slot = next(
                 (i for i, s in enumerate(self._slots) if s is None), None
             )
             if slot is None:
                 break
-            req = self._queue[0]
-            p = len(req.prompt)
-            need = self._blocks_needed(p, req.max_new_tokens)
-            ids = self.allocator.alloc(need)
-            if ids is None:
+            if not self._admit_one(slot, self._queue[0]):
                 break   # head waits for evictions; nothing jumps the queue
             self._queue.popleft()
-            self.stats["admitted"] += 1
-
-            nb = -(-p // bs)                       # blocks prefill fills
-            pb = nb * bs                           # scatter width
-            pf = min(pb, self.config.n_positions)  # forward width
-            prompt_arr = np.zeros((1, pf), np.int32)
-            prompt_arr[0, :p] = req.prompt
-            first, key, k, v = self._prefill_fn(
-                self.params, prompt_arr, np.int32(p), req._key, pad_to=pb,
-            )
-            self.stats["prefills"] += 1
-            first_i = int(first)
-            req.generated.append(first_i)
-            self.stats["tokens_out"] += 1
-            req._emit(first_i)
-
-            if self.serve.eos_id is not None and first_i == self.serve.eos_id:
-                req._finish("eos")
-            elif req.max_new_tokens == 1:
-                req._finish("length")
-            if req.done:
-                # Finished at prefill: blocks go straight back, the slot
-                # was never occupied, the scatter is skipped.
-                self.allocator.release(ids)
-                self.stats["finished"] += 1
-                continue
-
-            self.k_pool, self.v_pool = scatter_prefill(
-                self.k_pool, self.v_pool, k, v,
-                np.asarray(ids[:nb], np.int32),
-            )
-            req._slot, req._blocks = slot, ids
-            self._slots[slot] = req
-            self.block_table[slot, :] = 0
-            self.block_table[slot, :need] = ids
-            self.pos[slot] = p
-            self.tokens[slot] = first_i
-            self.active[slot] = True
-            self.keys[slot] = np.asarray(key)
             admitted += 1
         return admitted
 
+    # ------------------------------------------------------------ prefill
+
+    def _prefill_whole(self, slot: int, req: RequestHandle) -> int:
+        """PR 7 whole-prompt prefill: bucketed dense forward + block
+        scatter. Only for fresh, cache-miss admissions — continuations
+        (cache hits, resumes) go through the chunk path, which can start
+        mid-sequence."""
+        bs = self.serve.block_size
+        p = len(req._work)
+        nb = -(-p // bs)                       # blocks prefill fills
+        pb = nb * bs                           # scatter width
+        pf = min(pb, self.config.n_positions)  # forward width
+        prompt_arr = np.zeros((1, pf), np.int32)
+        prompt_arr[0, :p] = req._work
+        t0 = time.monotonic()
+        first, key, k, v = self._prefill_fn(
+            self.params, prompt_arr, np.int32(p), req._key, pad_to=pb,
+        )
+        self.k_pool, self.v_pool = scatter_prefill(
+            self.k_pool, self.v_pool, k, v,
+            np.asarray(req._blocks[:nb], np.int32),
+        )
+        first.block_until_ready()
+        self.stats["prefill_ms"] += (time.monotonic() - t0) * 1e3
+        self.stats["prefills"] += 1
+        req._prefill_pos = None
+        self._register_prefix(req)
+        return self._activate(slot, req, p, first, key)
+
+    def _prefill_step(self, slot: int, req: RequestHandle) -> int:
+        """Advance one prefill chunk; on the final chunk, activate the
+        decode row. Returns tokens emitted (1 when a fresh request's first
+        token fires)."""
+        s = req._prefill_pos
+        work = req._work
+        p_work = len(work)
+        if self.serve.prefill_chunk:
+            width = self.serve.prefill_chunk
+        elif req.generated:
+            # Preemption resume: the work prompt grows with every generated
+            # token, so bucketing its remainder would compile a fresh width
+            # per resume length. One full-width program covers them all.
+            width = self._m * self.serve.block_size
+        else:
+            # Fresh-admission cache-hit continuation: the remainder is
+            # bounded by the prompt, so these share the same block-multiple
+            # buckets the whole-prompt path compiles anyway.
+            bs = self.serve.block_size
+            width = min(-(-(p_work - s) // bs) * bs, self._m * bs)
+        cl = min(width, p_work - s)
+        chunk = np.zeros((1, width), np.int32)
+        chunk[0, :cl] = work[s:s + cl]
+        t0 = time.monotonic()
+        first, key, self.k_pool, self.v_pool = self._chunk_fn(
+            self.params, self.k_pool, self.v_pool,
+            np.ascontiguousarray(self.block_table[slot]), chunk,
+            np.int32(s), np.int32(cl), req._key,
+        )
+        first.block_until_ready()
+        self.stats["prefill_ms"] += (time.monotonic() - t0) * 1e3
+        self.stats["prefill_chunks"] += 1
+        s += cl
+        if s < p_work:
+            req._prefill_pos = s
+            return 0
+        self.stats["prefills"] += 1
+        req._prefill_pos = None
+        self._register_prefix(req)
+        return self._activate(slot, req, p_work, first, key)
+
+    def _activate(self, slot: int, req: RequestHandle, p_work: int,
+                  first, key) -> int:
+        """Prefill done: emit the sampled first token (fresh requests) or
+        restore the carried pending token (resumes — no re-emit, no
+        resample), then open the decode row."""
+        emitted = 0
+        if req._pending_token is None:
+            first_i = int(first)
+            req.generated.append(first_i)
+            self.stats["tokens_out"] += 1
+            emitted = 1
+            req._emit(first_i)
+            if self.serve.eos_id is not None and first_i == self.serve.eos_id:
+                self._evict(slot, "eos")
+                return emitted
+            if len(req.generated) >= req.max_new_tokens:
+                self._evict(slot, "length")
+                return emitted
+            self.tokens[slot] = first_i
+            self.keys[slot] = np.asarray(key)
+        else:
+            # Resume: the preempted request's last sampled token was
+            # already emitted and already passed the EOS/length gates —
+            # it becomes the decode input, and the chunk fn's sampled
+            # token/advanced key are discarded in favor of the saved
+            # chain head (bit-parity: one split per sampled token).
+            self.tokens[slot] = req._pending_token
+            req._pending_token = None
+            self.keys[slot] = np.asarray(req._key)
+        self.pos[slot] = p_work
+        self.active[slot] = True
+        return emitted
+
+    def _register_prefix(self, req: RequestHandle) -> None:
+        """Hash-cons every full work-prompt block into the prefix cache
+        (first writer wins; hits re-register as no-ops). Valid for resumed
+        work prompts too: K/V at position i is a pure function of
+        tokens[0..i], so a block is reusable by ANY request whose prompt
+        starts with the same tokens — whether they came from a prompt or
+        from generation."""
+        if self._cache is None:
+            return
+        w = req._work
+        for j in range(len(w) // self.serve.block_size):
+            self._cache.insert(w, j, req._blocks[j], self.allocator)
+
+    def _prefill_tick(self) -> int:
+        """Chunked mode: advance the OLDEST in-progress prefill by one
+        chunk per engine step — decode steps interleave between chunks,
+        which is the whole point."""
+        if self.serve.prefill_chunk == 0:
+            return 0
+        cands = [
+            (self._slots[s]._admit_order, s)
+            for s in range(self.serve.max_batch)
+            if self._slots[s] is not None
+            and self._slots[s]._prefill_pos is not None
+        ]
+        if not cands:
+            return 0
+        _, slot = min(cands)
+        return self._prefill_step(slot, self._slots[slot])
+
     # -------------------------------------------------------------- churn
 
-    def _evict(self, slot: int, reason: str) -> None:
+    def _release_slot(self, slot: int) -> None:
         req = self._slots[slot]
-        req._finish(reason)
         self.allocator.release(req._blocks)
         req._slot, req._blocks = None, None
+        req._work, req._prefill_pos = None, None
         self._slots[slot] = None
         # Table row back to the null block; the slot decodes as a no-op
         # (length 0) until the next admission overwrites it.
         self.block_table[slot, :] = 0
         self.pos[slot] = 0
         self.active[slot] = False
+
+    def _evict(self, slot: int, reason: str) -> None:
+        req = self._slots[slot]
+        req._finish(reason)
+        self._release_slot(slot)
         self.stats["finished"] += 1
+
+    def _preempt(self, slot: int) -> None:
+        """Swap a request out: free its blocks, requeue it at the head
+        with its generated tokens as recompute-prefill. The last sampled
+        token (already emitted) is carried as the pending decode input so
+        the resume neither re-emits nor resamples."""
+        req = self._slots[slot]
+        req.preemptions += 1
+        self.stats["preemptions"] += 1
+        if req._prefill_pos is None:
+            # Decoding: the slot key is the live chain head. (A request
+            # preempted mid-prefill never advanced its chain — req._key
+            # already holds the head.)
+            req._key = np.array(self.keys[slot])
+        req._pending_token = req.generated[-1] if req.generated else None
+        self._release_slot(slot)
+        req._enqueue_time = time.monotonic()
+        self._queue.appendleft(req)
+
+    def _grow_tables(self) -> None:
+        """Watermark mode, before each decode step: every active row about
+        to write into an unallocated block gets one. On pool exhaustion,
+        preempt the NEWEST-admitted request (possibly a prefilling one)
+        and retry — oldest-first iteration means an old request steals
+        from newer ones, never the reverse, so the oldest always runs to
+        completion and the engine cannot livelock."""
+        bs = self.serve.block_size
+        order = sorted(
+            (s for s in range(self.serve.max_batch)
+             if self._slots[s] is not None and self.active[s]),
+            key=lambda s: self._slots[s]._admit_order,
+        )
+        for slot in order:
+            req = self._slots[slot]
+            if req is None or not self.active[slot]:
+                continue    # preempted by an older row's growth below
+            while int(self.pos[slot]) // bs >= len(req._blocks):
+                ids = self._alloc_blocks(1, 0)
+                if ids is not None:
+                    req._blocks.append(ids[0])
+                    self.block_table[slot, len(req._blocks) - 1] = ids[0]
+                    continue
+                victim = max(
+                    (s for s in range(self.serve.max_batch)
+                     if self._slots[s] is not None),
+                    key=lambda s: self._slots[s]._admit_order,
+                )
+                self._preempt(victim)
+                if victim == slot:
+                    break   # preempted ourselves: row is gone (safety net —
+                            # submit() guarantees one request always fits)
 
     def _has_active(self) -> bool:
         return any(s is not None for s in self._slots)
 
     def step(self) -> int:
-        """One engine step: admit what fits, then one compiled decode step
-        for the whole batch. Returns tokens emitted (0 = nothing in
-        flight)."""
+        """One engine step: admit what fits, advance one prefill chunk
+        (chunked mode), grow/preempt block tables (watermark mode), then
+        one compiled decode step for every active row. Returns tokens
+        emitted this step (prefill first-tokens + decode samples)."""
         self._try_admit()
-        if not self._has_active():
-            return 0
+        emitted = self._prefill_tick()
+        if not bool(self.active.any()):
+            return emitted
+        if self.serve.admission == "watermark":
+            self._grow_tables()
+            if not bool(self.active.any()):
+                return emitted
 
         was_active = self.active.copy()
+        t0 = time.monotonic()
         next_tokens, new_keys, self.k_pool, self.v_pool = self._decode_fn(
             self.params, self.k_pool, self.v_pool, self.block_table,
             self.tokens, self.pos, self.active, self.keys,
         )
-        self.stats["decode_steps"] += 1
         toks_host = np.asarray(next_tokens)
+        self.stats["decode_ms"] += (time.monotonic() - t0) * 1e3
+        self.stats["decode_steps"] += 1
         self.keys = np.array(new_keys)  # writable copy: admission writes rows
         # Advance every row that decoded this step; evictions below then
-        # reset their rows.
+        # reset their rows. Prefilling rows (occupied, inactive) hold still.
         self.tokens = np.where(was_active, toks_host, self.tokens)
         self.pos = np.where(was_active, self.pos + 1, self.pos)
-        emitted = 0
+        decoded = 0
         for slot, req in enumerate(self._slots):
-            if req is None:
+            if req is None or not was_active[slot]:
                 continue
             t = int(toks_host[slot])
             req.generated.append(t)
-            emitted += 1
+            decoded += 1
             req._emit(t)
             if self.serve.eos_id is not None and t == self.serve.eos_id:
                 self._evict(slot, "eos")
             elif len(req.generated) >= req.max_new_tokens:
                 self._evict(slot, "length")
-        self.stats["tokens_out"] += emitted
-        return emitted
+        self.stats["tokens_out"] += decoded  # prefill firsts counted at emit
+        return emitted + decoded
 
     def run_until_idle(self, max_steps: int | None = None) -> int:
         """Drive ``step`` until the queue and every slot drain. Returns
         total tokens emitted. ``submit``'s block-need check guarantees the
-        queue head can always be admitted once the engine is empty, so this
+        queue head can always be admitted once the engine is empty (the
+        watermark floor is waived for an empty engine), so this
         terminates."""
         total = 0
         steps = 0
@@ -471,3 +872,25 @@ class ServingEngine:
                     f"{sum(s is not None for s in self._slots)} in flight"
                 )
         return total
+
+    # ------------------------------------------------------------ metrics
+
+    def metrics_snapshot(self) -> dict[str, float]:
+        """Current serving-load metrics, named for the TB sink
+        (``metrics/builtin.py`` registers each under ``serve/``)."""
+        adm = max(self.stats["admitted"], 1)
+        return {
+            "queue_wait_ms": self.stats["queue_wait_ms"] / adm,
+            "preempted": float(self.stats["preemptions"]),
+            "prefix_cached_tokens": float(self.stats["prefix_hit_tokens"]),
+            "serve_queue_depth": float(len(self._queue)),
+            "serve_occupancy": float(
+                sum(s is not None for s in self._slots)
+            ),
+        }
+
+    def clear_prefix_cache(self) -> None:
+        """Drop every unpinned prefix-cache entry and return its blocks
+        (bench isolation between warmup and the measured run)."""
+        if self._cache is not None:
+            self._cache.clear(self.allocator)

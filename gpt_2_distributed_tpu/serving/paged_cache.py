@@ -30,13 +30,15 @@ from gpt_2_distributed_tpu.config import GPT2Config, ServeConfig
 
 
 class BlockAllocator:
-    """Free-list allocator over pool blocks ``1..num_blocks-1`` (0 = null).
+    """Refcounted free-list allocator over pool blocks ``1..num_blocks-1``
+    (0 = null).
 
-    ``alloc`` is all-or-nothing: a request either gets every block its
-    worst-case length needs at admission, or stays queued — an admitted
-    sequence can never hit a mid-decode out-of-memory (the simple
-    no-preemption admission policy; vLLM-style swapping/recompute is the
-    obvious extension if traces demand it).
+    ``alloc`` is all-or-nothing: the caller either gets every block it
+    asked for, or None with the free list untouched. Blocks are refcounted
+    so the prefix cache can pin a block (``retain``) while the request
+    that wrote it still holds it — ``release`` decrements, and the block
+    returns to the free list only at refcount zero. Double-free / foreign
+    ids still fail loudly.
     """
 
     def __init__(self, num_blocks: int):
@@ -48,32 +50,131 @@ class BlockAllocator:
         self._free: collections.deque[int] = collections.deque(
             range(1, num_blocks)
         )
-        self._held: set[int] = set()
+        self._held: dict[int, int] = {}
 
     @property
     def available(self) -> int:
         return len(self._free)
 
     def alloc(self, n: int) -> list[int] | None:
-        """n blocks, or None (leaving the free list untouched) if the pool
-        can't currently cover them."""
+        """n blocks at refcount 1, or None (leaving the free list
+        untouched) if the pool can't currently cover them."""
         if n < 1:
             raise ValueError(f"alloc({n}): need at least one block")
         if n > len(self._free):
             return None
         ids = [self._free.popleft() for _ in range(n)]
-        self._held.update(ids)
+        for i in ids:
+            self._held[i] = 1
         return ids
 
+    def retain(self, i: int) -> None:
+        """Add a reference to an already-allocated block (prefix-cache
+        sharing: the cache and each request using the block hold one
+        reference each)."""
+        if i not in self._held:
+            raise ValueError(f"retain({i}): not an allocated block")
+        self._held[i] += 1
+
+    def refcount(self, i: int) -> int:
+        """Current reference count (0 = free / never allocated)."""
+        return self._held.get(i, 0)
+
     def release(self, ids: Iterable[int]) -> None:
+        """Drop one reference per id; blocks reaching refcount zero return
+        to the free list."""
         for i in ids:
             if i not in self._held:
                 raise ValueError(
                     f"release({i}): not an allocated block (double free, the "
                     f"null block, or a foreign id)"
                 )
-            self._held.discard(i)
-            self._free.append(i)
+            self._held[i] -= 1
+            if self._held[i] == 0:
+                del self._held[i]
+                self._free.append(i)
+
+
+class PrefixCache:
+    """Hash-cons of full KV blocks by token-prefix (LRU).
+
+    Key: the exact int32 token bytes of the prompt prefix a block
+    completes — block ``j`` of a prompt is cached under
+    ``tokens[:(j+1) * block_size]``. Content-addressing by prefix (not by
+    (block j's tokens, j)) is what makes sharing safe: K/V at position i
+    depends on every token ``<= i`` through attention, so two requests may
+    share a cached block only when their *entire* prefix up to that block's
+    end matches.
+
+    The cache holds one allocator reference per entry (``retain`` at
+    insert). Lookup returns the longest run of leading full-block hits —
+    a miss at block j ends the run because block j+1's K/V would attend
+    into the missed span. Eviction (LRU) only considers entries whose
+    refcount is 1, i.e. blocks no live request still holds.
+    """
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self._entries: collections.OrderedDict[bytes, int] = (
+            collections.OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @staticmethod
+    def _key(tokens, end: int) -> bytes:
+        import numpy as np
+
+        return np.asarray(tokens[:end], np.int32).tobytes()
+
+    def lookup(self, tokens) -> list[int]:
+        """Longest run of leading full-block hits for this token sequence;
+        returns the cached block ids (caller must ``retain`` each before
+        use). Hit entries move to MRU."""
+        run: list[int] = []
+        for j in range(len(tokens) // self.block_size):
+            key = self._key(tokens, (j + 1) * self.block_size)
+            bid = self._entries.get(key)
+            if bid is None:
+                self.misses += 1
+                break
+            self._entries.move_to_end(key)
+            self.hits += 1
+            run.append(bid)
+        return run
+
+    def insert(self, tokens, j: int, block_id: int,
+               allocator: BlockAllocator) -> bool:
+        """Register block ``block_id`` as holding block ``j`` of
+        ``tokens``. First writer wins: if the prefix is already cached
+        (another request registered its own copy) this is a no-op."""
+        key = self._key(tokens, (j + 1) * self.block_size)
+        if key in self._entries:
+            return False
+        allocator.retain(block_id)
+        self._entries[key] = block_id
+        return True
+
+    def evict_one(self, allocator: BlockAllocator) -> bool:
+        """Drop the LRU entry whose block no live request holds
+        (refcount 1 = cache-only). Returns False when every entry is
+        still pinned by an in-flight request."""
+        for key, bid in self._entries.items():
+            if allocator.refcount(bid) == 1:
+                del self._entries[key]
+                allocator.release([bid])
+                self.evictions += 1
+                return True
+        return False
+
+    def clear(self, allocator: BlockAllocator) -> None:
+        """Drop every unpinned entry (bench warmup isolation)."""
+        while self.evict_one(allocator):
+            pass
 
 
 def init_pools(
@@ -123,4 +224,25 @@ def scatter_prefill(
     return (
         k_pool.at[:, block_ids].set(kb.astype(k_pool.dtype)),
         v_pool.at[:, block_ids].set(vb.astype(v_pool.dtype)),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def copy_block(
+    k_pool: jnp.ndarray,   # [L, N, H, bs, D]
+    v_pool: jnp.ndarray,
+    src: jnp.ndarray,      # scalar int32 source block
+    dst: jnp.ndarray,      # scalar int32 destination block
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Copy-on-write: duplicate one pool block across all layers.
+
+    Used when a prompt ends exactly on a cached block boundary — the
+    request gets a private copy of the final cached block so its own
+    tail writes (the last prompt position is recomputed to produce the
+    first-token logits) can't corrupt the shared entry. src/dst are
+    traced, so this compiles once per pool shape.
+    """
+    return (
+        k_pool.at[:, dst].set(k_pool[:, src]),
+        v_pool.at[:, dst].set(v_pool[:, src]),
     )

@@ -12,9 +12,20 @@ JSONL file or stdin, one object per line::
 fully offline. Per-line fields default to --new / --seed. Output is JSONL
 on stdout: with ``--stream`` a ``{"id", "token"}`` line per token as it is
 produced, and always a final ``{"id", ..., "generated", "ttft_ms",
-"finish_reason"}`` record per request. All requests are in flight together
-up to ``--max_batch`` — submission order is admission order (FIFO), but
+"queue_wait_ms", "preempted", "prefix_cached_tokens", "finish_reason"}``
+record per request. All requests are in flight together up to
+``--max_batch`` — submission order is admission order (FIFO), but
 completions interleave.
+
+Scheduler knobs pass straight through to ``ServeConfig``:
+``--prefill_chunk N`` interleaves N-token prompt chunks with decode steps,
+``--prefix_cache`` reuses KV blocks across requests sharing a prompt
+prefix, and ``--admission watermark`` (with ``--watermark_blocks``)
+switches from worst-case block reservation to lazy growth with
+preempt-and-recompute under pool pressure. ``--tb_dir`` streams serving
+load (queue depth/wait, occupancy, preemptions, prefix hits) to
+TensorBoard through the shared StatsTracker every ``--metrics_every``
+engine steps.
 
 Usage::
 
@@ -65,8 +76,22 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="KV pool blocks; 0 = max_batch worst-case sequences")
     p.add_argument("--attn_impl", default="auto",
                    choices=["auto", "xla", "pallas"])
+    p.add_argument("--prefill_chunk", type=int, default=0,
+                   help="prefill chunk width; 0 = whole-prompt prefill")
+    p.add_argument("--prefix_cache", action="store_true",
+                   help="reuse KV blocks across shared prompt prefixes")
+    p.add_argument("--admission", default="reserve",
+                   choices=["reserve", "watermark"],
+                   help="block grant policy: worst-case reservation, or "
+                   "lazy growth with preemption under pool pressure")
+    p.add_argument("--watermark_blocks", type=int, default=1,
+                   help="free-block floor for --admission watermark")
     p.add_argument("--stream", action="store_true",
                    help="emit a JSON line per token as it is generated")
+    p.add_argument("--tb_dir", default=None,
+                   help="TensorBoard dir for serving-load metrics")
+    p.add_argument("--metrics_every", type=int, default=20,
+                   help="engine steps between --tb_dir metric flushes")
     p.add_argument("--device", default=None,
                    help="jax platform override (cpu|tpu)")
     return p
@@ -152,9 +177,22 @@ def main(argv: list[str] | None = None) -> None:
     serve = ServeConfig(
         max_batch=args.max_batch, block_size=args.block_size,
         num_blocks=num_blocks, attn_impl=args.attn_impl, eos_id=args.eos,
+        prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache,
+        admission=args.admission, watermark_blocks=args.watermark_blocks,
     )
     eng = ServingEngine(params, config, serve,
                         temperature=args.temperature, top_k=args.top_k)
+
+    tracker = None
+    if args.tb_dir:
+        from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
+
+        # batch/seq 0: the serving sink never counts training tokens —
+        # every update is out-of-band (count_tokens=False), TB-only.
+        tracker = StatsTracker(
+            args.tb_dir, batch_size=0, seq_len=0,
+            print_fn=lambda s: print(s, file=sys.stderr),
+        )
 
     def on_token(req, tok):
         if args.stream:
@@ -169,7 +207,19 @@ def main(argv: list[str] | None = None) -> None:
             handles.append(eng.submit(ids, new, rng=seed, on_token=on_token))
         except ValueError as e:
             sys.exit(f"request {len(handles)}: {e}")
-    eng.run_until_idle()
+    if tracker is None:
+        eng.run_until_idle()
+    else:
+        steps = 0
+        while eng._queue or eng._has_active():
+            eng.step()
+            steps += 1
+            if steps % max(args.metrics_every, 1) == 0:
+                tracker.update(steps, count_tokens=False,
+                               **eng.metrics_snapshot())
+        tracker.update(steps + 1, count_tokens=False,
+                       **eng.metrics_snapshot())
+        tracker.close()
     wall = time.monotonic() - t0
 
     for h in handles:
@@ -179,11 +229,16 @@ def main(argv: list[str] | None = None) -> None:
             "text": enc.decode(h.generated) if enc is not None else None,
             "finish_reason": h.finish_reason,
             "ttft_ms": round((h.first_token_time - h.submit_time) * 1e3, 2),
+            "queue_wait_ms": round(h.queue_wait_ms, 2),
+            "preempted": h.preemptions,
+            "prefix_cached_tokens": h.prefix_cached_tokens,
         }), flush=True)
     toks = sum(len(h.generated) for h in handles)
     print(f"{len(handles)} requests, {toks} tokens, {wall:.3f}s "
           f"({toks / wall:.0f} tok/s), {eng.stats['decode_steps']} decode "
-          f"steps", file=sys.stderr)
+          f"steps, {eng.stats['preemptions']} preemptions, "
+          f"{eng.stats['prefix_hit_tokens']} prefix-cached tokens",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -14,6 +14,7 @@ from gpt_2_distributed_tpu.ops.paged_attention import (
     paged_attention,
     paged_attention_pallas,
     paged_attention_xla,
+    paged_prefill_attention,
 )
 
 
@@ -126,6 +127,70 @@ def test_masked_tail_content_is_bitwise_invisible(rng_np):
             np.asarray(got), np.asarray(base[impl])
         ), impl
     assert MASK_VALUE < -1e3  # the mask must dominate the scribbled scores
+
+
+def _prefill_case(rng, b=2, t=5, h=2, d=8, bs=4, m=6, n_blocks=32):
+    """Chunk queries at arbitrary absolute starts over fully-built tables,
+    plus the dense per-sequence K/V views for reference computation."""
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    k_pool = jnp.asarray(rng.normal(size=(n_blocks, h, bs, d)), jnp.float32)
+    v_pool = jnp.asarray(rng.normal(size=(n_blocks, h, bs, d)), jnp.float32)
+    perm = rng.permutation(np.arange(1, n_blocks))[: b * m]
+    table = jnp.asarray(perm.reshape(b, m), jnp.int32)
+    start = jnp.asarray(rng.integers(0, m * bs - t + 1, b), jnp.int32)
+    kc = np.asarray(k_pool)[np.asarray(table)]
+    kc = kc.transpose(0, 2, 1, 3, 4).reshape(b, h, m * bs, d)
+    vc = np.asarray(v_pool)[np.asarray(table)]
+    vc = vc.transpose(0, 2, 1, 3, 4).reshape(b, h, m * bs, d)
+    return q, k_pool, v_pool, table, start, kc, vc
+
+
+def _prefill_dense_reference(q, kc, vc, start):
+    """fp64 causal softmax: query t of sequence b attends to positions
+    <= start[b] + t of the table's contiguous view."""
+    b, t, h, d = q.shape
+    out = np.zeros((b, t, h, d))
+    for i in range(b):
+        for tt in range(t):
+            ln = int(start[i]) + tt + 1
+            s = np.einsum(
+                "hd,hkd->hk", np.asarray(q[i, tt], np.float64),
+                kc[i, :, :ln].astype(np.float64),
+            ) / np.sqrt(d)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            out[i, tt] = np.einsum(
+                "hk,hkd->hd", p, vc[i, :, :ln].astype(np.float64)
+            )
+    return out
+
+
+def test_prefill_matches_dense_reference(rng_np):
+    q, kp, vp, table, start, kc, vc = _prefill_case(rng_np)
+    got = paged_prefill_attention(q, kp, vp, table, start)
+    want = _prefill_dense_reference(q, kc, vc, start)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+def test_prefill_future_positions_are_bitwise_invisible(rng_np):
+    """Chunked prefill attends over a PARTIALLY-built table: everything
+    past the chunk's causal frontier is stale garbage by construction, and
+    must be bitwise invisible to every query row."""
+    q, kp, vp, table, start, _, _ = _prefill_case(rng_np)
+    base = paged_prefill_attention(q, kp, vp, table, start)
+    t, bs = q.shape[1], kp.shape[2]
+    kn, vn = np.array(kp), np.array(vp)
+    for i in range(q.shape[0]):
+        frontier = int(start[i]) + t - 1     # last attendable position
+        for j, blk in enumerate(np.asarray(table[i])):
+            lo = max(0, frontier + 1 - j * bs)
+            if lo < bs:
+                kn[blk, :, lo:] = 1e6
+                vn[blk, :, lo:] = -1e6
+    got = paged_prefill_attention(
+        q, jnp.asarray(kn), jnp.asarray(vn), table, start
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(base))
 
 
 def test_rejects_bad_impl_and_shapes(rng_np):

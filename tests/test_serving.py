@@ -14,6 +14,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -23,7 +24,11 @@ import pytest
 from gpt_2_distributed_tpu.config import ServeConfig
 from gpt_2_distributed_tpu.models import gpt2
 from gpt_2_distributed_tpu.models.decode import generate_cached
-from gpt_2_distributed_tpu.serving import BlockAllocator, ServingEngine
+from gpt_2_distributed_tpu.serving import (
+    BlockAllocator,
+    PrefixCache,
+    ServingEngine,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH_SERVE = os.path.join(REPO, "scripts", "bench_serve.py")
@@ -32,6 +37,23 @@ BENCH_SERVE = os.path.join(REPO, "scripts", "bench_serve.py")
 @pytest.fixture(scope="module")
 def tiny_params(tiny_config):
     return gpt2.init_params(tiny_config, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def _tier1_runtime_budget(request):
+    """Default-tier budget guard: every non-slow test in this module must
+    finish well inside tier-1's suite timeout. The scheduler property tests
+    are deliberately sized down (tiny config, few prompt/new shapes so the
+    one-shot references share jit cache entries); a test blowing this budget
+    means someone scaled a config up — push it to @slow instead."""
+    t0 = time.perf_counter()
+    yield
+    if request.node.get_closest_marker("slow") is None:
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 90, (
+            f"{request.node.name} took {elapsed:.1f}s — default-tier tests "
+            "must stay under 90s; size the config down or mark it slow"
+        )
 
 
 def _serve(**kw):
@@ -83,6 +105,73 @@ class TestBlockAllocator:
             ServeConfig(max_batch=0)
         with pytest.raises(ValueError):
             ServeConfig(block_size=0)
+
+    def test_refcount_retain_release(self):
+        # Prefix sharing rests on this: a block freed by its writer stays
+        # alive while anyone (the cache, another request) still holds it.
+        a = BlockAllocator(8)
+        [b] = a.alloc(1)
+        assert a.refcount(b) == 1
+        a.retain(b)
+        assert a.refcount(b) == 2
+        a.release([b])                  # writer done; cache still holds it
+        assert a.refcount(b) == 1 and a.available == 6
+        a.release([b])
+        assert a.refcount(b) == 0 and a.available == 7
+        with pytest.raises(ValueError, match="double free"):
+            a.release([b])
+        with pytest.raises(ValueError, match="not an allocated block"):
+            a.retain(b)                 # free blocks can't be re-pinned
+
+
+class TestPrefixCache:
+    def test_lookup_returns_longest_leading_run(self):
+        a = BlockAllocator(16)
+        c = PrefixCache(4)
+        toks = list(range(12))          # exactly 3 full blocks
+        ids = a.alloc(3)
+        for j, b in enumerate(ids):
+            assert c.insert(toks, j, b, a)
+        assert all(a.refcount(b) == 2 for b in ids)  # writer + cache
+        assert c.lookup(toks) == ids
+        # Diverging at block 1 ends the run at block 0 — block 1's K/V
+        # attends into the span that differs.
+        assert c.lookup(toks[:4] + [99] * 8) == ids[:1]
+        # No full block, no hits; and a hit can't start past a miss.
+        assert c.lookup(toks[:3]) == []
+        assert c.lookup([99] + toks[1:]) == []
+        # First writer wins: re-inserting is a no-op, no double pin.
+        assert not c.insert(toks, 0, ids[0], a)
+        assert a.refcount(ids[0]) == 2
+
+    def test_evict_one_skips_pinned_entries(self):
+        a = BlockAllocator(16)
+        c = PrefixCache(4)
+        toks = list(range(8))
+        ids = a.alloc(2)
+        for j, b in enumerate(ids):
+            c.insert(toks, j, b, a)
+        a.release([ids[0]])             # request dropped block 0 only
+        assert c.evict_one(a)           # cache-only entry goes first
+        assert a.refcount(ids[0]) == 0
+        assert not c.evict_one(a)       # the survivor is pinned: refuse
+        assert len(c) == 1
+        a.release([ids[1]])
+        c.clear(a)
+        assert len(c) == 0 and a.available == 15
+
+    def test_lookup_refreshes_lru_order(self):
+        a = BlockAllocator(16)
+        c = PrefixCache(2)
+        [b1] = a.alloc(1)
+        c.insert([1, 2], 0, b1, a)
+        a.release([b1])
+        [b2] = a.alloc(1)
+        c.insert([3, 4], 0, b2, a)
+        a.release([b2])
+        assert c.lookup([1, 2]) == [b1]  # touch: b2 becomes the LRU entry
+        assert c.evict_one(a)
+        assert a.refcount(b2) == 0 and a.refcount(b1) == 1
 
 
 # ----------------------------------------------------- engine bit-parity
@@ -290,6 +379,228 @@ def test_submit_validation_shared_with_decode_paths(
                       temperature=1.0, top_k=0)
 
 
+# ----------------------------------------- chunked prefill / prefix cache
+
+
+def test_chunked_prefill_bit_parity_any_chunk_width(tiny_params, tiny_config):
+    # The chunk split is a scheduling choice, not a numerics choice: any
+    # width reproduces whole-prompt prefill bit-for-bit, and the fixed
+    # width keeps the chunk program at ONE compile per engine.
+    prompts, news, keys = _mixed_trace()
+    for chunk in (1, 3, 19):
+        eng = ServingEngine(tiny_params, tiny_config,
+                            _serve(prefill_chunk=chunk), temperature=0.0)
+        hs = [eng.submit(p, n, rng=k)
+              for p, n, k in zip(prompts, news, keys)]
+        eng.run_until_idle(max_steps=500)
+        assert eng._chunk_fn._cache_size() == 1, chunk
+        assert eng._decode_fn._cache_size() == 1, chunk
+        for h, p, n, k in zip(hs, prompts, news, keys):
+            ref = _oneshot(tiny_params, tiny_config, p, k, n, temperature=0.0)
+            assert h.generated == ref, (chunk, h.id)
+        assert eng.allocator.available == eng.serve.num_blocks - 1
+
+
+def test_chunked_prefill_sampled_prng_chain_intact(tiny_params, tiny_config):
+    # Every chunk samples (one compiled program), the host discards all but
+    # the final draw — the request's threefry chain must land exactly where
+    # the one-shot path leaves it.
+    prompts, news, keys = _mixed_trace()
+    eng = ServingEngine(tiny_params, tiny_config, _serve(prefill_chunk=5),
+                        temperature=0.9, top_k=40)
+    hs = [eng.submit(p, n, rng=k) for p, n, k in zip(prompts, news, keys)]
+    eng.run_until_idle(max_steps=500)
+    for h, p, n, k in zip(hs, prompts, news, keys):
+        ref = _oneshot(tiny_params, tiny_config, p, k, n,
+                       temperature=0.9, top_k=40)
+        assert h.generated == ref, h.id
+
+
+def test_prefix_cache_reuse_bit_parity_and_accounting(
+    tiny_params, tiny_config,
+):
+    # Two prompts sharing a 16-token (2-block) prefix: the second must skip
+    # prefill for the cached span, report it, and still stream the exact
+    # bits of a cold run — cached K/V is a pure function of the prefix.
+    pfx = list(range(50, 66))
+    p1, p2 = pfx + [7, 8, 9], pfx + [10, 11]
+    eng = ServingEngine(tiny_params, tiny_config, _serve(prefix_cache=True),
+                        temperature=0.0)
+    h1 = eng.submit(p1, 6, rng=jax.random.PRNGKey(1))
+    eng.run_until_idle(max_steps=100)
+    assert eng.stats["prefix_hit_tokens"] == 0
+    h2 = eng.submit(p2, 6, rng=jax.random.PRNGKey(2))
+    eng.run_until_idle(max_steps=100)
+    assert eng.stats["prefix_hit_tokens"] == 16
+    assert h1.prefix_cached_tokens == 0 and h2.prefix_cached_tokens == 16
+    for h, p, s in ((h1, p1, 1), (h2, p2, 2)):
+        ref = _oneshot(tiny_params, tiny_config, p, jax.random.PRNGKey(s), 6,
+                       temperature=0.0)
+        assert h.generated == ref, h.id
+    # Cache entries are the only blocks still out; clearing balances books.
+    assert eng.allocator.available == (
+        eng.serve.num_blocks - 1 - len(eng._cache)
+    )
+    eng.clear_prefix_cache()
+    assert eng.allocator.available == eng.serve.num_blocks - 1
+
+
+def test_prefix_cache_with_chunked_prefill_sampled(tiny_params, tiny_config):
+    # The two features compose: a cache hit moves the chunk walk's start,
+    # chunks resume mid-prompt, and the sampled stream is still bit-exact.
+    pfx = list(range(30, 46))
+    specs = [(pfx + [9, 8, 7, 6], 7), (pfx + [5, 4], 5), (pfx[:8] + [3], 4)]
+    eng = ServingEngine(
+        tiny_params, tiny_config,
+        _serve(prefix_cache=True, prefill_chunk=3),
+        temperature=0.9, top_k=40,
+    )
+    hs = []
+    for i, (p, n) in enumerate(specs):
+        hs.append(eng.submit(p, n, rng=jax.random.PRNGKey(60 + i)))
+        eng.run_until_idle(max_steps=200)   # serialize to make hits certain
+    assert eng.stats["prefix_hit_tokens"] == 16 + 8
+    assert eng._chunk_fn._cache_size() == 1
+    for h, (p, n), i in zip(hs, specs, range(3)):
+        ref = _oneshot(tiny_params, tiny_config, p,
+                       jax.random.PRNGKey(60 + i), n,
+                       temperature=0.9, top_k=40)
+        assert h.generated == ref, h.id
+
+
+def test_cow_on_block_aligned_cached_prompt(tiny_params, tiny_config):
+    # A fully-cached, block-aligned prompt must copy-on-write its tail
+    # block: the last position is recomputed for its logits and scattered
+    # into the PRIVATE copy. The shared entry must survive unscathed for a
+    # third request that extends the prefix.
+    p = list(range(100, 116))               # exactly 2 blocks of 8
+    eng = ServingEngine(tiny_params, tiny_config, _serve(prefix_cache=True),
+                        temperature=0.0)
+    key = jax.random.PRNGKey(5)
+    h1 = eng.submit(p, 5, rng=key)
+    eng.run_until_idle(max_steps=100)
+    h2 = eng.submit(p, 5, rng=key)          # identical prompt: full hit
+    eng.run_until_idle(max_steps=100)
+    assert eng.stats["cow_copies"] == 1
+    assert h2.prefix_cached_tokens == 15    # all but the recomputed last
+    ref = _oneshot(tiny_params, tiny_config, p, key, 5, temperature=0.0)
+    assert h1.generated == ref and h2.generated == ref
+    # h2 decoded over its private tail copy; the cached block must still
+    # hold the ORIGINAL prefix K/V for an extending prompt.
+    p3 = p + [11, 12, 13]
+    h3 = eng.submit(p3, 4, rng=key)
+    eng.run_until_idle(max_steps=100)
+    assert h3.prefix_cached_tokens == 16
+    ref3 = _oneshot(tiny_params, tiny_config, p3, key, 4, temperature=0.0)
+    assert h3.generated == ref3
+
+
+# ------------------------------------------- watermark admission / preempt
+
+
+def test_watermark_preemption_bit_parity_and_accounting(
+    tiny_params, tiny_config,
+):
+    # 6 requests, 7 allocatable blocks, lazy grants: growth must exhaust
+    # the pool and preempt (newest victim), and every stream must still
+    # bit-match its solo run — recompute-prefill restores the PRNG chain
+    # head and never re-emits.
+    serve = _serve(max_batch=4, num_blocks=8,
+                   admission="watermark", watermark_blocks=1)
+    eng = ServingEngine(tiny_params, tiny_config, serve, temperature=0.0)
+    specs = [([3 * i + 1, 3 * i + 2, 3 * i + 3], 14) for i in range(6)]
+    hs = [eng.submit(p, n, rng=jax.random.PRNGKey(40 + i))
+          for i, (p, n) in enumerate(specs)]
+    eng.run_until_idle(max_steps=1000)
+    assert eng.stats["preemptions"] > 0
+    assert eng.stats["preemptions"] == sum(h.preemptions for h in hs)
+    # Whole-prompt resumes share ONE full-width chunk program; decode
+    # stays one program through all the churn.
+    assert eng._chunk_fn._cache_size() == 1
+    assert eng._decode_fn._cache_size() == 1
+    for h, (p, n), i in zip(hs, specs, range(6)):
+        ref = _oneshot(tiny_params, tiny_config, p,
+                       jax.random.PRNGKey(40 + i), n, temperature=0.0)
+        assert h.generated == ref, h.id
+        assert h.resumes == h.preemptions       # every swap-out came back
+        assert h.queue_wait_ms >= 0 and h.done
+        assert h.submit_time <= h.first_token_time <= h.finish_time
+    assert eng.allocator.available == serve.num_blocks - 1
+
+
+@pytest.mark.parametrize(
+    "chunk,temp", [(0, 0.0), (0, 0.9), (5, 0.0), (5, 0.9)],
+)
+def test_scheduler_churn_property(tiny_params, tiny_config, chunk, temp):
+    # The whole scheduler surface at once: shared-prefix traffic, chunked
+    # or whole prefill, watermark grants sized to force preemption — and
+    # the exactness contract must hold for EVERY request, greedy and
+    # sampled, with the compiled-program census unchanged.
+    rng = np.random.default_rng(7)
+    pfx = list(range(200, 208))             # one full shared block
+    plens, news = (5, 9, 13, 17), (6, 12)   # few shapes: refs stay cheap
+    specs = []
+    for i in range(8):
+        pl, nw = plens[i % 4], news[i % 2]
+        p = (pfx + rng.integers(1, 257, pl - 8).tolist()
+             if i % 3 != 2 and pl > 8
+             else rng.integers(1, 257, pl).tolist())
+        specs.append((p, nw))
+    top_k = 40 if temp else None
+    serve = _serve(max_batch=4, num_blocks=8, prefix_cache=True,
+                   admission="watermark", watermark_blocks=1,
+                   prefill_chunk=chunk)
+    eng = ServingEngine(tiny_params, tiny_config, serve,
+                        temperature=temp, top_k=top_k)
+    hs = [eng.submit(p, n, rng=jax.random.PRNGKey(1000 + i))
+          for i, (p, n) in enumerate(specs)]
+    eng.run_until_idle(max_steps=2000)
+    assert eng._decode_fn._cache_size() == 1
+    if chunk:
+        assert eng._chunk_fn._cache_size() == 1
+    assert eng.stats["preemptions"] > 0     # the pool is sized to force it
+    assert eng.stats["prefix_hit_tokens"] > 0
+    for h, (p, n), i in zip(hs, specs, range(8)):
+        ref = _oneshot(tiny_params, tiny_config, p,
+                       jax.random.PRNGKey(1000 + i), n,
+                       temperature=temp, top_k=top_k)
+        assert h.generated == ref, h.id
+    assert eng.allocator.available == (
+        serve.num_blocks - 1 - len(eng._cache)
+    )
+    eng.clear_prefix_cache()
+    assert eng.allocator.available == serve.num_blocks - 1
+
+
+def test_pool_garbage_is_invisible_under_chunked_prefill(
+    tiny_params, tiny_config,
+):
+    # Chunked prefill scatters K/V at position granularity, so unwritten
+    # pool positions keep whatever they held. Pre-poisoning the entire pool
+    # must not flip a single output bit: every read is either overwritten
+    # first or causally masked to an exact zero.
+    prompts, news, keys = _mixed_trace()
+    outs = []
+    for poison in (False, True):
+        eng = ServingEngine(
+            tiny_params, tiny_config,
+            _serve(prefill_chunk=3, prefix_cache=True,
+                   admission="watermark"),
+            temperature=0.0,
+        )
+        if poison:
+            eng.k_pool = jnp.full_like(eng.k_pool, 999.0)
+            eng.v_pool = jnp.full_like(eng.v_pool, -999.0)
+        hs = [eng.submit(p, n, rng=k)
+              for p, n, k in zip(prompts, news, keys)]
+        eng.run_until_idle(max_steps=500)
+        outs.append([h.generated for h in hs])
+    assert outs[0] == outs[1]
+    for got, p, n, k in zip(outs[1], prompts, news, keys):
+        ref = _oneshot(tiny_params, tiny_config, p, k, n, temperature=0.0)
+        assert got == ref
+
+
 # ------------------------------------------------------ bench_serve CLI
 
 
@@ -318,6 +629,8 @@ def test_bench_serve_help_is_jax_free(tmp_path):
     r = _run_bench_serve("--help", poison_jax_dir=_poison(tmp_path))
     assert r.returncode == 0, r.stderr[-500:]
     assert "--rate" in r.stdout
+    assert "--shared_prefix_frac" in r.stdout
+    assert "--admission" in r.stdout
 
 
 def test_bench_serve_rejects_unhonorable_flags(tmp_path):
@@ -331,6 +644,13 @@ def test_bench_serve_rejects_unhonorable_flags(tmp_path):
         (("--rate", "0"), "--rate"),
         (("--prompt_min", "0"), "--prompt_min"),
         (("--new_min", "9", "--new_max", "3"), "--new_min"),
+        (("--shared_prefix_frac", "1.5"), "--shared_prefix_frac"),
+        (("--traces", "shared_prefix", "--shared_prefix_len", "0"),
+         "--shared_prefix_len"),
+        (("--num_blocks_shared", "-1"), "--num_blocks_shared"),
+        (("--prefill_chunk", "-1"), "--prefill_chunk"),
+        (("--watermark_blocks", "-1"), "--watermark_blocks"),
+        (("--repeats", "0"), "--repeats"),
     ):
         r = _run_bench_serve(*flags, poison_jax_dir=poison)
         assert r.returncode != 0, flags
@@ -349,12 +669,19 @@ def test_bench_serve_rejects_trace_exceeding_context(capsys):
         mod.main(["--seq_len", "64", "--prompt_max", "40",
                   "--new_max", "40"])
     assert "n_positions" in capsys.readouterr().err
+    # The shared-prefix trace lengthens prompts to prefix+1: the fit check
+    # must account for that, not just --prompt_max.
+    with pytest.raises(SystemExit):
+        mod.main(["--seq_len", "64", "--traces", "shared_prefix",
+                  "--shared_prefix_len", "60"])
+    assert "n_positions" in capsys.readouterr().err
 
 
 @pytest.mark.slow
 def test_bench_serve_end_to_end(tmp_path):
-    # Full trace on the tiny config: engine + baseline, JSON artifact
-    # written, continuous batching reported against the one-shot path.
+    # Both traces on the tiny config, one repeat: engine + PR 7 replay +
+    # one-shot baseline per trace, JSON artifact written, and the streams
+    # bit-identical across the two scheduler configurations.
     out = tmp_path / "bench_serve.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     r = subprocess.run(
@@ -364,15 +691,27 @@ def test_bench_serve_end_to_end(tmp_path):
          "--requests", "8", "--prompt_min", "2", "--prompt_max", "10",
          "--new_min", "4", "--new_max", "10",
          "--max_batch", "4", "--block_size", "8",
+         "--traces", "both", "--shared_prefix_len", "8",
+         "--num_blocks_shared", "12", "--repeats", "1",
          "--json", str(out)],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
     )
     assert r.returncode == 0, r.stderr[-2000:]
     rec = json.loads(r.stdout.strip().splitlines()[-1])
-    assert rec["engine"]["tok_s"] > 0
-    assert rec["engine"]["decode_steps"] > 0
-    assert rec["oneshot_baseline"]["tok_s"] > 0
-    assert rec["speedup_vs_oneshot"] > 0
+    for name in ("original", "shared_prefix"):
+        sec = rec["traces"][name]
+        assert sec["engine"]["tok_s"] > 0, name
+        assert sec["engine"]["decode_steps"] > 0, name
+        assert sec["streams_bit_identical"] is True, name
+        assert sec["speedup_vs_pr7"] > 0, name
+        assert sec["oneshot_baseline"]["tok_s"] > 0, name
+        assert sec["speedup_vs_oneshot"] > 0, name
+    # The shared trace shares a full block per prefixed prompt, so the
+    # engine-under-test (prefix cache on) must report hits; the PR 7
+    # replay (cache off) must not.
+    shared = rec["traces"]["shared_prefix"]
+    assert shared["engine"]["prefix_cache_hit_rate"] > 0
+    assert shared["engine_pr7"]["prefix_cache_hit_rate"] == 0
     assert json.loads(out.read_text()) == rec
 
 
@@ -392,7 +731,9 @@ def test_serve_cli_end_to_end_stream(tmp_path):
          "--n_layer", "2", "--n_embd", "32", "--n_head", "2",
          "--vocab_size", "257", "--seq_len", "64",
          "--requests", str(reqs), "--temperature", "0",
-         "--max_batch", "2", "--block_size", "8", "--stream"],
+         "--max_batch", "2", "--block_size", "8", "--stream",
+         "--prefill_chunk", "2", "--prefix_cache",
+         "--admission", "watermark", "--watermark_blocks", "1"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
     )
     assert r.returncode == 0, r.stderr[-2000:]
@@ -405,3 +746,7 @@ def test_serve_cli_end_to_end_stream(tmp_path):
         toks = [s["token"] for s in streams if s["id"] == f["id"]]
         assert toks == f["generated"]
         assert f["ttft_ms"] >= 0
+        # Scheduler accounting rides along on every final record.
+        assert f["queue_wait_ms"] >= 0
+        assert f["preempted"] == 0          # pool is ample here
+        assert f["prefix_cached_tokens"] >= 0
