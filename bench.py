@@ -170,7 +170,27 @@ def main() -> None:
         help="where --ckpt_every writes (default: a fresh temp dir, removed "
         "after the run)",
     )
+    p.add_argument(
+        "--xla_profile_at", default=None, metavar="STEP[:NSTEPS]",
+        help="capture an XLA profiler trace covering NSTEPS (default 1) "
+        "measured steps starting at STEP, written under "
+        "--xla_profile_dir/xla_profile (same capture train.py arms with "
+        "its --xla_profile_at)",
+    )
+    p.add_argument(
+        "--xla_profile_dir", default=None,
+        help="output root for --xla_profile_at",
+    )
     args = p.parse_args()
+    if args.xla_profile_at is not None:
+        from gpt_2_distributed_tpu.obs.trace import parse_profile_at
+
+        try:
+            parse_profile_at(args.xla_profile_at)
+        except ValueError as e:
+            p.error(str(e))
+        if not args.xla_profile_dir:
+            p.error("--xla_profile_at needs --xla_profile_dir for output")
     args.steps = max(1, args.steps)
     args.warmup = max(1, args.warmup)  # first call doubles as the compile step
 
@@ -572,12 +592,24 @@ def run_config(args, model: str, seq_len: int) -> dict:
         check_fingerprints(fingerprint_params(params))
         desync_check_ms = (time.perf_counter() - t_fp) * 1e3
 
+        from gpt_2_distributed_tpu.obs.trace import XlaCapture, parse_profile_at
+
+        xla_capture = XlaCapture(
+            parse_profile_at(getattr(args, "xla_profile_at", None)),
+            getattr(args, "xla_profile_dir", None),
+        )
+
         t0 = time.perf_counter()
         for i in range(steps):
+            xla_capture.maybe_start(i + 1)
             bus.exchange(0)
             params, opt_state, metrics = step(
                 params, opt_state, x, y, key, args.warmup + i
             )
+            # Stop one step late (train.py's convention): the bench never
+            # syncs inside the loop, so the slack lets the device drain the
+            # windowed steps before the capture ends.
+            xla_capture.maybe_stop(i)
             if saver is not None and (i + 1) % args.ckpt_every == 0:
                 saver.save(
                     i + 1, params, opt_state,
@@ -592,6 +624,7 @@ def run_config(args, model: str, seq_len: int) -> dict:
         # params) — a plain block_until_ready proved unreliable through remote
         # TPU tunnels.
         final_loss = float(metrics.loss)
+        xla_capture.stop_if_active()   # window ran past the loop's end
         dt = time.perf_counter() - t0
 
         # Update-phase attribution by step-delta: time the SAME accumulation

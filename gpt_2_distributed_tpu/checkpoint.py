@@ -55,6 +55,7 @@ import orbax.checkpoint as ocp
 
 from gpt_2_distributed_tpu import resilience
 from gpt_2_distributed_tpu.config import CheckpointPolicy
+from gpt_2_distributed_tpu.obs.trace import get_tracer
 
 STEP_DIR_RE = re.compile(r"^step_(\d{7,})$")
 
@@ -599,6 +600,13 @@ class CheckpointSaver:
         t0 = time.perf_counter()
         path = os.path.join(self.save_dir, step_dir_name(step))
         self._api_lock.acquire()
+        # ckpt_snapshot = the part the step loop stalls for: sync mode the
+        # whole write+commit, async mode the device->host snapshot + write
+        # initiation (the background stage traces itself as ckpt_commit).
+        snapshot_span = get_tracer().span(
+            "ckpt_snapshot", step=step, sync=not self.policy.async_save
+        )
+        snapshot_span.__enter__()
         try:
             if not self.policy.async_save:
                 ok = self._with_retries(
@@ -636,6 +644,7 @@ class CheckpointSaver:
             self._commit_thread.start()
             return path
         finally:
+            snapshot_span.__exit__(None, None, None)
             self._api_lock.release()
             self.save_block_ms = (time.perf_counter() - t0) * 1e3
 
@@ -650,34 +659,47 @@ class CheckpointSaver:
 
     def _commit_async(self, path: str, step: int,
                       meta: CheckpointMeta) -> None:
-        """Background stage: wait out the sharded write, then commit + GC."""
-        try:
-            for c in self._ckptrs:
-                c.wait_until_finished()
-        except Exception as exc:
-            # The write itself failed after the source buffers were donated
-            # away — nothing left to retry from. Leave the dir uncommitted
-            # (restore skips it, GC prunes it) and record the failure.
-            self.failed_saves += 1
-            self.last_error = f"{type(exc).__name__}: {exc}"
-            if jax.process_index() == 0:
-                print(
-                    f"[ckpt] WARNING: background write for "
-                    f"{os.path.basename(path)} failed ({self.last_error}); "
-                    f"dir left uncommitted"
+        """Background stage: wait out the sharded write, then commit + GC.
+
+        Runs on the commit thread, so its spans root a fresh per-thread
+        stack in the trace — the report shows the commit's wall time beside
+        (not inside) the steps it overlapped with.
+        """
+        tracer = get_tracer()
+        with tracer.span("ckpt_commit", step=step) as commit_span:
+            try:
+                with tracer.span("ckpt_write_wait", step=step):
+                    for c in self._ckptrs:
+                        c.wait_until_finished()
+            except Exception as exc:
+                # The write itself failed after the source buffers were
+                # donated away — nothing left to retry from. Leave the dir
+                # uncommitted (restore skips it, GC prunes it) and record
+                # the failure.
+                self.failed_saves += 1
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                commit_span.set(failed=True)
+                if jax.process_index() == 0:
+                    print(
+                        f"[ckpt] WARNING: background write for "
+                        f"{os.path.basename(path)} failed ({self.last_error}); "
+                        f"dir left uncommitted"
+                    )
+                return
+            delay_s = float(os.environ.get(COMMIT_DELAY_ENV, "0") or 0)
+            if delay_s > 0:
+                time.sleep(delay_s)
+            if self.pre_commit_hook is not None:
+                self.pre_commit_hook(path)
+            with tracer.span("ckpt_commit_files", step=step):
+                ok = self._with_retries(
+                    step, f"commit {os.path.basename(path)}",
+                    lambda: _commit_files(path, step, meta, verify=True),
                 )
-            return
-        delay_s = float(os.environ.get(COMMIT_DELAY_ENV, "0") or 0)
-        if delay_s > 0:
-            time.sleep(delay_s)
-        if self.pre_commit_hook is not None:
-            self.pre_commit_hook(path)
-        ok = self._with_retries(
-            step, f"commit {os.path.basename(path)}",
-            lambda: _commit_files(path, step, meta, verify=True),
-        )
-        if ok:
-            self._after_commit(path, step)
+            if ok:
+                self._after_commit(path, step)
+            else:
+                commit_span.set(failed=True)
 
     def _after_commit(self, path: str, step: int) -> None:
         self.committed_steps.append(step)
@@ -712,11 +734,12 @@ class CheckpointSaver:
             path = os.path.join(self.save_dir, step_dir_name(step))
             if step in self.committed_steps and is_committed_checkpoint(path):
                 return path
-            ok = self._with_retries(
-                step, f"emergency save {step_dir_name(step)}",
-                lambda: self._save_and_commit_sync(path, step, params,
-                                                   opt_state, meta),
-            )
+            with get_tracer().span("ckpt_emergency_save", step=step):
+                ok = self._with_retries(
+                    step, f"emergency save {step_dir_name(step)}",
+                    lambda: self._save_and_commit_sync(path, step, params,
+                                                       opt_state, meta),
+                )
             return path if ok else None
 
     def close(self) -> None:

@@ -55,6 +55,7 @@ import time
 from collections import Counter
 from typing import Any, Callable, NamedTuple
 
+from gpt_2_distributed_tpu.obs.trace import get_tracer
 from gpt_2_distributed_tpu.resilience import HANG_EXIT_CODE
 
 # --- part 1: step-consensus control word -------------------------------------
@@ -139,22 +140,26 @@ class ConsensusBus:
         self.total_exchange_ms = 0.0
 
     def exchange(self, word: int) -> int:
-        t0 = time.perf_counter()
-        if word & ~_ALL_BITS:
-            raise ValueError(f"control word {word:#x} has unknown bits set")
-        if self.process_count == 1:
-            agreed = int(word)
-        else:
-            import numpy as np
-            from jax.experimental import multihost_utils
+        # The span lives here (not at the call site) so every exchange — the
+        # step loop's, the epoch boundary's, bench.py's — lands in the trace
+        # under one name, parented by whatever span the caller has open.
+        with get_tracer().span("consensus_exchange", word=int(word)):
+            t0 = time.perf_counter()
+            if word & ~_ALL_BITS:
+                raise ValueError(f"control word {word:#x} has unknown bits set")
+            if self.process_count == 1:
+                agreed = int(word)
+            else:
+                import numpy as np
+                from jax.experimental import multihost_utils
 
-            gathered = multihost_utils.process_allgather(
-                np.asarray(word, np.int64)
-            )
-            agreed = or_reduce_words(np.ravel(gathered))
-        self.exchanges += 1
-        self.last_exchange_ms = (time.perf_counter() - t0) * 1e3
-        self.total_exchange_ms += self.last_exchange_ms
+                gathered = multihost_utils.process_allgather(
+                    np.asarray(word, np.int64)
+                )
+                agreed = or_reduce_words(np.ravel(gathered))
+            self.exchanges += 1
+            self.last_exchange_ms = (time.perf_counter() - t0) * 1e3
+            self.total_exchange_ms += self.last_exchange_ms
         return agreed
 
     @property
@@ -235,9 +240,10 @@ def assert_pod_agreement(name: str, value: float) -> None:
     import numpy as np
     from jax.experimental import multihost_utils
 
-    gathered = np.ravel(
-        multihost_utils.process_allgather(np.asarray(value, np.float64))
-    )
+    with get_tracer().span("pod_barrier", barrier=name):
+        gathered = np.ravel(
+            multihost_utils.process_allgather(np.asarray(value, np.float64))
+        )
     bad = mismatched_ranks([float(v) for v in gathered])
     if bad:
         raise RuntimeError(
@@ -375,6 +381,18 @@ class HangWatchdog:
         )
         try:
             faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        except Exception:
+            pass
+        # Raw stacks name the *frame* the pod died in; the span stack names
+        # the *phase* — "step > step_dispatch" vs "step > consensus_exchange"
+        # is the first question a hang post-mortem asks.
+        try:
+            tracer = get_tracer()
+            if tracer.enabled:
+                msg = "[watchdog] " + tracer.format_open_spans()
+                print(msg, flush=True)
+                print(msg, file=sys.stderr, flush=True)
+                tracer.event("hang_watchdog_fired", timeout_s=self.timeout_s)
         except Exception:
             pass
         if self.on_hang is not None:

@@ -63,9 +63,17 @@ METRIC_REGISTRY.metric(
 # (resilience.SKIP_REASON_NAMES; 0 = never skipped). skipped_steps shows on
 # the CLI line only once a skip happened (a steady "skipped: 0" would be
 # noise); the reason code is TB-only.
+#
+# Counter metrics below declare dist_reduce="sum": across a pod the total is
+# the number that means something, not the per-host mean. They stay
+# distributed=False because they are pushed *conditionally* (only once
+# nonzero) — the cross-process allgather needs every host to push the same
+# key set in the same update() call, which host-local counters can't
+# guarantee. The declaration makes the strategy explicit for any reduce path
+# that does see them (custom reduce_fn, or a future symmetric-push cadence).
 METRIC_REGISTRY.metric(
     "skipped_steps", reduction=ReductionStrategy.CURRENT,
-    cli_format="skipped: {value:.0f}",
+    dist_reduce="sum", cli_format="skipped: {value:.0f}",
 )(lambda v: float(int(v)))
 
 METRIC_REGISTRY.metric(
@@ -76,7 +84,7 @@ METRIC_REGISTRY.metric(
 # finite-but-huge gradient was per-layer-clipped and applied instead of
 # skipped. Like skipped_steps, pushed only once the first clip happens.
 METRIC_REGISTRY.metric(
-    "clipped_steps", reduction=ReductionStrategy.CURRENT,
+    "clipped_steps", reduction=ReductionStrategy.CURRENT, dist_reduce="sum",
     cli_format="clipped: {value:.0f}",
 )(lambda v: float(int(v)))
 
@@ -85,7 +93,7 @@ METRIC_REGISTRY.metric(
 # write died after the source buffers were donated away). Non-zero means the
 # run is progressing but its on-disk save cadence has gaps.
 METRIC_REGISTRY.metric(
-    "save_failures", reduction=ReductionStrategy.CURRENT,
+    "save_failures", reduction=ReductionStrategy.CURRENT, dist_reduce="sum",
     cli_format="save_fail: {value:.0f}",
 )(lambda v: float(int(v)))
 
@@ -94,7 +102,7 @@ METRIC_REGISTRY.metric(
 # parameter fingerprint disagreed with the pod. Each detection routes into
 # the rollback-to-last-verified path; pushed only once nonzero.
 METRIC_REGISTRY.metric(
-    "desync_detected", reduction=ReductionStrategy.CURRENT,
+    "desync_detected", reduction=ReductionStrategy.CURRENT, dist_reduce="max",
     cli_format="desync: {value:.0f}",
 )(lambda v: float(int(v)))
 
@@ -103,8 +111,20 @@ METRIC_REGISTRY.metric(
 # re-attempted). Non-zero means the storage layer is flaky but survivable;
 # pushed only once nonzero.
 METRIC_REGISTRY.metric(
-    "data_read_retries", reduction=ReductionStrategy.CURRENT,
+    "data_read_retries", reduction=ReductionStrategy.CURRENT, dist_reduce="sum",
     cli_format="io_retry: {value:.0f}",
+)(lambda v: float(int(v)))
+
+# Fused-path degradation (ops/spmd.py fused_fallback_count): trace-time count
+# of requested --fused_layers/--fused_matmul sites that degraded to unfused
+# ops (once per compiled shape, not per step). train.py has pushed this since
+# the fused-ops PR, but it was never registered — the tracker silently
+# dropped every push (the exact bug class StatsTracker.strict and
+# tests/test_metric_registration.py now kill). TB-only: the warn-once at the
+# fallback site already narrates it.
+METRIC_REGISTRY.metric(
+    "fused_fallback", reduction=ReductionStrategy.CURRENT, dist_reduce="max",
+    cli_format=None,
 )(lambda v: float(int(v)))
 
 # Elastic resume (train.py elastic hook): pushed only by runs that resumed at
@@ -219,16 +239,16 @@ def collect_memory(tracker: "StatsTracker") -> dict[str, float]:
 # each flush pushes the engine's metrics_snapshot() as-of-now (wait is a
 # running mean, preempted/prefix tokens are cumulative counters).
 
-for _name in (
-    "queue_wait_ms",          # mean enqueue->admission gap per admission
-    "preempted",              # cumulative pool-pressure swap-outs
-    "prefix_cached_tokens",   # cumulative prompt tokens served from cache
-    "serve_queue_depth",      # requests waiting for a slot, as of the flush
-    "serve_occupancy",        # occupied decode slots, as of the flush
+for _name, _dist in (
+    ("queue_wait_ms", "mean"),         # mean enqueue->admission gap per admission
+    ("preempted", "sum"),              # cumulative pool-pressure swap-outs
+    ("prefix_cached_tokens", "sum"),   # cumulative prompt tokens served from cache
+    ("serve_queue_depth", "sum"),      # requests waiting for a slot, as of the flush
+    ("serve_occupancy", "sum"),        # occupied decode slots, as of the flush
 ):
     METRIC_REGISTRY.metric(
         _name, reduction=ReductionStrategy.CURRENT, tb_prefix="serve/",
-        cli_format=None,
+        dist_reduce=_dist, cli_format=None,
     )(float)
 
 

@@ -48,7 +48,14 @@ class MetricDefinition:
     ``collector`` is a pull-style source invoked by the tracker every
     ``frequency`` steps, returning ``{metric_name: value}`` for one or more
     metrics (the reference uses this for perf and memory metrics).
-    ``distributed`` marks the value for cross-process mean-reduction.
+    ``distributed`` marks the value for cross-process reduction, and
+    ``dist_reduce`` says *how* it combines across processes: ``"mean"``
+    (per-host averages — right for loss/grad_norm, which are already
+    globally reduced on device), ``"sum"`` (host-local counters like
+    ``skipped_steps``/``preempted``, where the pod total is the number that
+    means something), or ``"max"`` (worst-host values like a peak
+    allocation). Distinct from ``reduction``, which collapses one process's
+    *time window* to a TB scalar.
     """
 
     name: str
@@ -59,6 +66,14 @@ class MetricDefinition:
     processor: Callable[[Any], float] | None = None
     collector: Callable[..., dict[str, float]] | None = None
     distributed: bool = False
+    dist_reduce: str = "mean"               # cross-process: mean | sum | max
+
+    def __post_init__(self) -> None:
+        if self.dist_reduce not in ("mean", "sum", "max"):
+            raise ValueError(
+                f"metric {self.name!r}: dist_reduce must be mean|sum|max, "
+                f"got {self.dist_reduce!r}"
+            )
 
     @property
     def tb_tag(self) -> str:

@@ -13,9 +13,11 @@ window resets at each CLI tick.
 
 Deliberate deviations, recorded for the parity ledger:
 
-* Cross-process reduction is a **mean** for every distributed metric — the
-  reference's ``_all_reduce_scalar`` sums then divides by world size
-  regardless of the metric's declared strategy (``:25-34``; SURVEY.md C21).
+* Cross-process reduction honors each metric's declared ``dist_reduce``
+  (mean | sum | max) via one shared allgather — the reference's
+  ``_all_reduce_scalar`` means everything regardless of the metric's
+  declared strategy (``:25-34``; SURVEY.md C21), which silently averages
+  counters that should sum.
 * The driver passes the **global** effective batch size (micro-batch x
   grad_accum x data-parallel degree), so ``tokens_per_second`` is true system
   throughput with no cross-process reduction — fixing the reference's
@@ -42,8 +44,14 @@ WINDOW_SIZE = 50          # reference deque maxlen, stats_tracker.py:404-409
 TB_FLUSH_INTERVAL_S = 30  # reference flush cadence, stats_tracker.py:563-594
 
 
-def _default_reduce(values: dict[str, float]) -> dict[str, float]:
-    """Cross-process mean of each scalar. Identity when single-process."""
+def _default_reduce(
+    values: dict[str, float], registry: MetricRegistry = METRIC_REGISTRY
+) -> dict[str, float]:
+    """Cross-process combine of each scalar, honoring the metric's declared
+    ``dist_reduce`` (mean | sum | max). One allgather covers every key —
+    per-strategy combination happens host-side on the gathered (world, k)
+    array, so declaring ``sum`` for a counter costs nothing extra. Identity
+    when single-process."""
     import jax
 
     if jax.process_count() == 1:
@@ -53,8 +61,19 @@ def _default_reduce(values: dict[str, float]) -> dict[str, float]:
 
     keys = sorted(values)
     arr = np.asarray([values[k] for k in keys], dtype=np.float64)
-    summed = multihost_utils.process_allgather(arr).sum(axis=0)
-    return {k: float(s / jax.process_count()) for k, s in zip(keys, summed)}
+    gathered = multihost_utils.process_allgather(arr)  # (world, k)
+    out: dict[str, float] = {}
+    for i, k in enumerate(keys):
+        d = registry.get(k)
+        strategy = d.dist_reduce if d is not None else "mean"
+        col = gathered[:, i]
+        if strategy == "sum":
+            out[k] = float(col.sum())
+        elif strategy == "max":
+            out[k] = float(col.max())
+        else:
+            out[k] = float(col.sum() / jax.process_count())
+    return out
 
 
 class StatsTracker:
@@ -82,10 +101,12 @@ class StatsTracker:
         n_chips: int | None = None,
         print_fn: Callable[[str], None] = print,
         is_primary: bool | None = None,
+        strict: bool = False,
     ) -> None:
         import jax
 
         self.registry = registry
+        self.strict = strict
         self.tb_every = max(1, int(tb_every))
         self.cli_every = max(1, int(cli_every))
         self.world_size = world_size if world_size is not None else jax.process_count()
@@ -93,7 +114,12 @@ class StatsTracker:
         self.tokens_per_step = int(batch_size) * int(seq_len)
         self.flops_per_token = flops_per_token
         self.peak_flops_per_chip = peak_flops_per_chip
-        self.reduce_fn = reduce_fn if reduce_fn is not None else _default_reduce
+        if reduce_fn is not None:
+            self.reduce_fn = reduce_fn
+        else:
+            # Bind the registry so per-metric dist_reduce declarations route
+            # through the default reduction.
+            self.reduce_fn = lambda vals: _default_reduce(vals, self.registry)
         self.print_fn = print_fn
         if is_primary is None:
             is_primary = jax.process_index() == 0
@@ -107,6 +133,10 @@ class StatsTracker:
         self.epoch_start_time = time.perf_counter()
         self.current_epoch = 0
         self._last_flush = time.perf_counter()
+        # Unregistered pushes are never silent: counted here, warned once
+        # per name (raised instead under strict=True).
+        self.dropped_metrics: dict[str, int] = {}
+        self._warned_unregistered: set[str] = set()
 
         self.writer = None
         if tb_dir and self.is_primary:
@@ -139,6 +169,21 @@ class StatsTracker:
         for name, value in metrics.items():
             d = self.registry.get(name)
             if d is None:
+                if self.strict:
+                    raise KeyError(
+                        f"metric {name!r} pushed to StatsTracker.update but "
+                        f"never registered (see metrics/builtin.py)"
+                    )
+                self.dropped_metrics[name] = self.dropped_metrics.get(name, 0) + 1
+                if name not in self._warned_unregistered:
+                    self._warned_unregistered.add(name)
+                    import warnings
+
+                    warnings.warn(
+                        f"StatsTracker: dropping unregistered metric {name!r} "
+                        f"(register it in metrics/builtin.py; this warns once)",
+                        stacklevel=2,
+                    )
                 continue
             v = float(d.processor(value)) if d.processor else float(value)
             if d.distributed and self.world_size > 1:
@@ -155,8 +200,11 @@ class StatsTracker:
             # stop. Re-running the freq-1 perf collector here would compute
             # tok/s over the eval's wall time (~0 tokens) and overwrite the
             # step's throughput/MFU series; re-running the CLI cadence would
-            # print a duplicate line and reset the token window.
-            if self.writer is not None:
+            # print a duplicate line and reset the token window. The
+            # tb_every cadence applies here too — the value stays buffered
+            # either way, so a skipped write still lands in the window the
+            # next on-cadence _write_tensorboard collapses.
+            if self.writer is not None and step % self.tb_every == 0:
                 for name in processed:
                     d = self.registry.get(name)
                     v = self._window_value(d)

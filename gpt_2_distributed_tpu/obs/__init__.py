@@ -1,0 +1,16 @@
+"""Observability: span-based structured tracing (`obs.trace`).
+
+Metrics (windows -> TB/CLI) live in `gpt_2_distributed_tpu.metrics`; this
+package answers the question metrics cannot: *where inside the step did the
+time go*, and *what did this one serving request live through*. See
+`scripts/obs_report.py` for the reader side.
+"""
+
+from gpt_2_distributed_tpu.obs.trace import (
+    Tracer,
+    configure_tracing,
+    get_tracer,
+    parse_profile_at,
+)
+
+__all__ = ["Tracer", "configure_tracing", "get_tracer", "parse_profile_at"]

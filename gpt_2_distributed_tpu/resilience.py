@@ -349,6 +349,9 @@ class PreemptionHandler:
         already = self._flag
         self._flag = True
         if not already:
+            from gpt_2_distributed_tpu.obs.trace import get_tracer
+
+            get_tracer().event("preempt_notice", reason=reason)
             print(
                 f"[preempt] {reason}; will save an emergency checkpoint and "
                 f"exit {PREEMPTED_EXIT_CODE} at the next step boundary",

@@ -80,6 +80,7 @@ import numpy as np
 
 from gpt_2_distributed_tpu.config import GPT2Config, ServeConfig
 from gpt_2_distributed_tpu.models import decode, gpt2
+from gpt_2_distributed_tpu.obs.trace import get_tracer
 from gpt_2_distributed_tpu.models.generate import (
     check_generation_args,
     sample_token,
@@ -141,6 +142,12 @@ class RequestHandle:
     def _emit(self, tok: int) -> None:
         if self.first_token_time is None:
             self.first_token_time = time.monotonic()
+            # ts is the handle's OWN stamp (monotonic == perf_counter's
+            # CLOCK_MONOTONIC on Linux), so a trace-derived TTFT equals the
+            # engine's first_token_time - submit_time accounting exactly.
+            get_tracer().event(
+                "first_token", ts=self.first_token_time, rid=self.id
+            )
         if self.on_token is not None:
             self.on_token(self, tok)
 
@@ -148,6 +155,10 @@ class RequestHandle:
         self.done = True
         self.finish_reason = reason
         self.finish_time = time.monotonic()
+        get_tracer().event(
+            "finish", ts=self.finish_time, rid=self.id, reason=reason,
+            n_generated=len(self.generated),
+        )
 
 
 def _prefill_impl(
@@ -485,6 +496,10 @@ class ServingEngine:
         req.submit_time = time.monotonic()
         req._enqueue_time = req.submit_time
         self._queue.append(req)
+        get_tracer().event(
+            "submit", ts=req.submit_time, rid=req.id,
+            prompt_len=len(prompt), max_new_tokens=max_new_tokens,
+        )
         return req
 
     def _alloc_blocks(self, n: int, floor: int) -> list[int] | None:
@@ -555,6 +570,7 @@ class ServingEngine:
             )
             self.allocator.release([cow_src])   # drop the copy-window pin
             self.stats["cow_copies"] += 1
+            get_tracer().event("cow", rid=req.id, src=cow_src, dst=dst)
 
         now = time.monotonic()
         req.queue_wait_ms += (now - req._enqueue_time) * 1e3
@@ -562,13 +578,20 @@ class ServingEngine:
         req._admit_order = self._admit_seq
         self._admit_seq += 1
         self.stats["admitted"] += 1
+        tracer = get_tracer()
+        tracer.event(
+            "admit", ts=now, rid=req.id, slot=slot,
+            queue_wait_ms=(now - req._enqueue_time) * 1e3,
+        )
         if resuming or (req.generated and req._pending_token is None):
             req.resumes += 1
             self.stats["resumes"] += 1
+            tracer.event("resume", ts=now, rid=req.id, slot=slot)
         if s0:
             self.stats["prefix_hit_tokens"] += s0
             if not req.generated:
                 req.prefix_cached_tokens = s0
+            tracer.event("prefix_hit", ts=now, rid=req.id, tokens=s0)
 
         blocks = shared + ids
         req._slot, req._blocks = slot, blocks
@@ -627,8 +650,13 @@ class ServingEngine:
             np.asarray(req._blocks[:nb], np.int32),
         )
         first.block_until_ready()
-        self.stats["prefill_ms"] += (time.monotonic() - t0) * 1e3
+        dur_ms = (time.monotonic() - t0) * 1e3
+        self.stats["prefill_ms"] += dur_ms
         self.stats["prefills"] += 1
+        get_tracer().event(
+            "prefill_chunk", rid=req.id, n_tokens=p, dur_ms=dur_ms,
+            whole=True,
+        )
         req._prefill_pos = None
         self._register_prefix(req)
         return self._activate(slot, req, p, first, key)
@@ -663,8 +691,13 @@ class ServingEngine:
             np.int32(s), np.int32(cl), req._key,
         )
         first.block_until_ready()
-        self.stats["prefill_ms"] += (time.monotonic() - t0) * 1e3
+        dur_ms = (time.monotonic() - t0) * 1e3
+        self.stats["prefill_ms"] += dur_ms
         self.stats["prefill_chunks"] += 1
+        get_tracer().event(
+            "prefill_chunk", rid=req.id, n_tokens=cl, dur_ms=dur_ms,
+            whole=False,
+        )
         s += cl
         if s < p_work:
             req._prefill_pos = s
@@ -773,6 +806,10 @@ class ServingEngine:
         req._pending_token = req.generated[-1] if req.generated else None
         self._release_slot(slot)
         req._enqueue_time = time.monotonic()
+        get_tracer().event(
+            "preempt", ts=req._enqueue_time, rid=req.id, slot=slot,
+            n_generated=len(req.generated),
+        )
         self._queue.appendleft(req)
 
     def _grow_tables(self) -> None:
@@ -816,16 +853,29 @@ class ServingEngine:
         (chunked mode), grow/preempt block tables (watermark mode), then
         one compiled decode step for every active row. Returns tokens
         emitted this step (prefill first-tokens + decode samples)."""
-        self._try_admit()
-        emitted = self._prefill_tick()
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return self._step_impl(tracer)
+        with tracer.span("engine_step", n=int(self.stats["decode_steps"])):
+            return self._step_impl(tracer)
+
+    def _step_impl(self, tracer) -> int:
+        with tracer.span("admit"):
+            self._try_admit()
+        with tracer.span("prefill"):
+            emitted = self._prefill_tick()
         if not bool(self.active.any()):
             return emitted
         if self.serve.admission == "watermark":
-            self._grow_tables()
+            with tracer.span("grow"):
+                self._grow_tables()
             if not bool(self.active.any()):
                 return emitted
 
         was_active = self.active.copy()
+        decode_span = tracer.span(
+            "decode", rows=int(was_active.sum())
+        ).__enter__()
         t0 = time.monotonic()
         next_tokens, new_keys, self.k_pool, self.v_pool = self._decode_fn(
             self.params, self.k_pool, self.v_pool, self.block_table,
@@ -834,6 +884,7 @@ class ServingEngine:
         toks_host = np.asarray(next_tokens)
         self.stats["decode_ms"] += (time.monotonic() - t0) * 1e3
         self.stats["decode_steps"] += 1
+        decode_span.__exit__(None, None, None)
         self.keys = np.array(new_keys)  # writable copy: admission writes rows
         # Advance every row that decoded this step; evictions below then
         # reset their rows. Prefilling rows (occupied, inactive) hold still.

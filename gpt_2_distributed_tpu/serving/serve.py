@@ -92,6 +92,14 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="TensorBoard dir for serving-load metrics")
     p.add_argument("--metrics_every", type=int, default=20,
                    help="engine steps between --tb_dir metric flushes")
+    p.add_argument("--trace_dir", default=None,
+                   help="write span/event trace JSONL here (obs/trace.py)")
+    p.add_argument("--trace_max_file_bytes", type=int, default=64 * 1024 * 1024,
+                   help="rotate trace-p*.jsonl past this size")
+    p.add_argument("--xla_profile_at", default=None, metavar="STEP[:NSTEPS]",
+                   help="capture an XLA profiler trace covering NSTEPS "
+                        "(default 1) engine steps starting at STEP; written "
+                        "under --trace_dir (or --tb_dir)/xla_profile")
     p.add_argument("--device", default=None,
                    help="jax platform override (cpu|tpu)")
     return p
@@ -110,7 +118,25 @@ def main(argv: list[str] | None = None) -> None:
     from gpt_2_distributed_tpu.checkpoint import latest_checkpoint, restore_params
     from gpt_2_distributed_tpu.config import MODEL_PRESETS, ServeConfig
     from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.obs.trace import (
+        XlaCapture,
+        configure_tracing,
+        get_tracer,
+        parse_profile_at,
+    )
     from gpt_2_distributed_tpu.serving import ServingEngine
+
+    if args.trace_dir:
+        configure_tracing(args.trace_dir,
+                          max_file_bytes=args.trace_max_file_bytes)
+    try:
+        xla_profile_spec = parse_profile_at(args.xla_profile_at)
+    except ValueError as e:
+        p.error(str(e))
+    profile_root = args.trace_dir or args.tb_dir
+    if xla_profile_spec and not profile_root:
+        p.error("--xla_profile_at needs --trace_dir or --tb_dir for output")
+    xla_capture = XlaCapture(xla_profile_spec, profile_root)
 
     overrides = {
         k: getattr(args, k)
@@ -207,19 +233,24 @@ def main(argv: list[str] | None = None) -> None:
             handles.append(eng.submit(ids, new, rng=seed, on_token=on_token))
         except ValueError as e:
             sys.exit(f"request {len(handles)}: {e}")
-    if tracker is None:
+    if tracker is None and xla_profile_spec is None:
         eng.run_until_idle()
     else:
         steps = 0
         while eng._queue or eng._has_active():
+            xla_capture.maybe_start(steps + 1)
             eng.step()
             steps += 1
-            if steps % max(args.metrics_every, 1) == 0:
+            xla_capture.maybe_stop(steps)
+            if tracker is not None and steps % max(args.metrics_every, 1) == 0:
                 tracker.update(steps, count_tokens=False,
                                **eng.metrics_snapshot())
-        tracker.update(steps + 1, count_tokens=False,
-                       **eng.metrics_snapshot())
-        tracker.close()
+        xla_capture.stop_if_active()
+        if tracker is not None:
+            tracker.update(steps + 1, count_tokens=False,
+                           **eng.metrics_snapshot())
+            tracker.close()
+    get_tracer().close()
     wall = time.monotonic() - t0
 
     for h in handles:

@@ -404,6 +404,23 @@ def build_parser() -> argparse.ArgumentParser:
         "JAX_PLATFORMS env var; default = JAX's own platform selection",
     )
     p.add_argument("--profile", action="store_true", help="jax.profiler trace into --log_dir")
+    p.add_argument(
+        "--xla_profile_at", default=None, metavar="STEP[:NSTEPS]",
+        help="on-demand XLA profiler window: capture NSTEPS (default 1) "
+        "optimizer steps starting at STEP into <log_dir>/xla_profile; host "
+        "spans bridge into the device timeline via TraceAnnotation. Unlike "
+        "--profile this skips compile/warmup noise and bounds trace size.",
+    )
+    p.add_argument(
+        "--trace_dir", default=None,
+        help="enable structured span tracing: per-process trace-p{rank}.jsonl "
+        "written here (obs/trace.py); analyze with scripts/obs_report.py. "
+        "Default off — the tracer is then a pure no-op.",
+    )
+    p.add_argument(
+        "--trace_max_file_bytes", type=int, default=64 * 1024 * 1024,
+        help="rotation bound per trace file (live file + one .1 generation)",
+    )
     p.add_argument("--cli_every", type=int, default=20)
     p.add_argument("--tb_every", type=int, default=1)
     p.add_argument("--coordinator_address", default=None)
@@ -610,6 +627,39 @@ def main(argv: list[str] | None = None) -> None:
         make_train_step,
     )
     from gpt_2_distributed_tpu.utils.flops import device_peak_flops, flops_per_token
+    from gpt_2_distributed_tpu.obs.trace import (
+        XlaCapture,
+        configure_tracing,
+        get_tracer,
+        parse_profile_at,
+    )
+
+    # --- observability ------------------------------------------------------
+    # Tracing defaults off; when off, get_tracer() hands out a no-op and no
+    # trace file is ever created (asserted by tests/test_obs.py).
+    if args.trace_dir:
+        configure_tracing(
+            args.trace_dir,
+            process_index=jax.process_index(),
+            max_file_bytes=args.trace_max_file_bytes,
+        )
+    tracer = get_tracer()
+    try:
+        xla_profile_spec = parse_profile_at(args.xla_profile_at)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from None
+    if xla_profile_spec and not args.log_dir:
+        raise SystemExit(
+            "error: --xla_profile_at needs --log_dir (the capture lands in "
+            "<log_dir>/xla_profile)"
+        )
+    if xla_profile_spec and args.profile:
+        raise SystemExit(
+            "error: --xla_profile_at and --profile both drive "
+            "jax.profiler.start_trace; profiler sessions cannot nest — "
+            "pick one"
+        )
+    xla_capture = XlaCapture(xla_profile_spec, args.log_dir)
 
     # --- config ------------------------------------------------------------
     overrides = {
@@ -689,6 +739,10 @@ def main(argv: list[str] | None = None) -> None:
             except ValueError as e:
                 raise SystemExit(f"error: elastic resume: {e}") from None
             elastic_delta = spec.n_devices - saved_devices
+            tracer.event(
+                "elastic_resize",
+                old_devices=saved_devices, new_devices=spec.n_devices,
+            )
             if is_primary():
                 print(
                     f"[elastic] world resized: {saved_devices} -> "
@@ -1105,6 +1159,8 @@ def main(argv: list[str] | None = None) -> None:
                 poller.stop()
             if saver is not None:
                 saver.close()
+            xla_capture.stop_if_active()
+            tracer.close()
 
         # --- epoch/step loop --------------------------------------------------
         # Metrics are consumed with a one-step lag: step N+1 is dispatched
@@ -1126,6 +1182,10 @@ def main(argv: list[str] | None = None) -> None:
                 return
             p_step, p_epoch, p_batch, p_m = pending
             pending = None
+            # The first host read of p_m below blocks until the dispatched
+            # step's device work completes — that wait IS the device_sync
+            # phase (everything after the first read is host arithmetic).
+            _sync_span = tracer.span("device_sync", step=p_step).__enter__()
             extra = {}
             if use_guard:
                 reason = int(p_m.skip_reason)
@@ -1138,6 +1198,7 @@ def main(argv: list[str] | None = None) -> None:
                 skip_observed_last = skip_observed_last or bool(reason)
                 if reason:
                     last_skip_reason_host = reason
+                    tracer.event("guard_skip", step=p_step, reason=reason)
                     if is_primary():
                         print(
                             f"[guard] step {p_step} skipped "
@@ -1207,7 +1268,9 @@ def main(argv: list[str] | None = None) -> None:
             if not (use_guard and int(p_m.skip_reason)):
                 values["loss"] = float(p_m.loss)
                 values["grad_norm"] = float(p_m.grad_norm)
-            tracker.update(p_step, **values, **extra)
+            _sync_span.__exit__(None, None, None)
+            with tracer.span("collector", step=p_step):
+                tracker.update(p_step, **values, **extra)
 
         def emergency_preempt_exit() -> None:
             """Preemption endgame (single-host: SIGTERM/poller flag at the
@@ -1215,6 +1278,9 @@ def main(argv: list[str] | None = None) -> None:
             commit one emergency checkpoint, quiesce, exit rc 143 — the rc
             supervise.sh relaunches without burning a restart attempt."""
             flush_pending()
+            end_step_span()
+            tracer.event("preempt_exit", step=global_step)
+            xla_capture.stop_if_active()
             if args.profile and args.log_dir:
                 jax.profiler.stop_trace()
             if watchdog is not None:
@@ -1245,6 +1311,9 @@ def main(argv: list[str] | None = None) -> None:
             rc that supervise.sh treats as a fault (burns an attempt —
             a worker death is not scheduled churn)."""
             flush_pending()
+            end_step_span()
+            tracer.event("worker_abort", step=global_step)
+            xla_capture.stop_if_active()
             if args.profile and args.log_dir:
                 jax.profiler.stop_trace()
             if watchdog is not None:
@@ -1269,6 +1338,26 @@ def main(argv: list[str] | None = None) -> None:
         done = False
         rollbacks_done = 0
         fired: set = set()  # in-process one-shot injections (no --save_dir)
+
+        # One "step" span per loop iteration, managed manually: the body has
+        # a dozen break/raise exits and a `with` would reindent all of them.
+        # begin() closes any span a break path left open, so nesting can
+        # never corrupt; the explicit end() calls sit on the paths that leave
+        # the loop (epoch end, emergency exits).
+        step_span = None
+
+        def begin_step_span() -> None:
+            nonlocal step_span
+            end_step_span()
+            if tracer.enabled:
+                step_span = tracer.span("step", n=global_step + 1)
+                step_span.__enter__()
+
+        def end_step_span() -> None:
+            nonlocal step_span
+            if step_span is not None:
+                step_span.__exit__(None, None, None)
+                step_span = None
         epoch, step_in_epoch = start_epoch, skip_steps
         # Multi-host periodic saves happen at the step boundary AFTER the
         # consensus exchange (so the decision to save is pod-agreed); this
@@ -1332,6 +1421,7 @@ def main(argv: list[str] | None = None) -> None:
                 # of truth for last_micro replay.
                 prefetched_dev = None
                 while step_in_epoch < epoch_opt_steps:
+                    begin_step_span()
                     # (1) Host-local fetch of one optimizer step's
                     # micro-batches. Deliberately NOT a collective: a host
                     # whose data worker just died still reaches the consensus
@@ -1340,9 +1430,10 @@ def main(argv: list[str] | None = None) -> None:
                     # in the train step's psum.
                     if worker_error is None:
                         try:
-                            while len(micro) < args.grad_accum_steps:
-                                xb, yb = next(loader_iter)
-                                micro.append((xb, yb))
+                            with tracer.span("data_fetch"):
+                                while len(micro) < args.grad_accum_steps:
+                                    xb, yb = next(loader_iter)
+                                    micro.append((xb, yb))
                         except StopIteration:
                             break
                         except RuntimeError as exc:
@@ -1388,9 +1479,10 @@ def main(argv: list[str] | None = None) -> None:
                         and global_step % coord_policy.desync_check_every == 0
                     ):
                         t_fp = time.perf_counter()
-                        bad_ranks = check_fingerprints(
-                            fingerprint_params(params)
-                        )
+                        with tracer.span("desync_check", step=global_step):
+                            bad_ranks = check_fingerprints(
+                                fingerprint_params(params)
+                            )
                         if bad_ranks:
                             desync_count += 1
                             rollback_requested = True
@@ -1532,10 +1624,12 @@ def main(argv: list[str] | None = None) -> None:
                         x, y = prefetched_dev
                         prefetched_dev = None
                     else:
-                        x = np.stack([m[0] for m in micro])
-                        y = np.stack([m[1] for m in micro])
-                        x, y = shard_batch((x, y), mesh)
+                        with tracer.span("h2d"):
+                            x = np.stack([m[0] for m in micro])
+                            y = np.stack([m[1] for m in micro])
+                            x, y = shard_batch((x, y), mesh)
                     micro = []
+                    xla_capture.maybe_start(global_step + 1)
                     if use_guard:
                         loss_scale = ones_scale
                         if (
@@ -1553,14 +1647,16 @@ def main(argv: list[str] | None = None) -> None:
                                 f"NaN at step {global_step + 1}",
                                 flush=True,
                             )
-                        params, opt_state, guard_state, m = train_step(
-                            params, opt_state, guard_state, x, y, rng,
-                            global_step, loss_scale,
-                        )
+                        with tracer.span("step_dispatch", step=global_step + 1):
+                            params, opt_state, guard_state, m = train_step(
+                                params, opt_state, guard_state, x, y, rng,
+                                global_step, loss_scale,
+                            )
                     else:
-                        params, opt_state, m = train_step(
-                            params, opt_state, x, y, rng, global_step
-                        )
+                        with tracer.span("step_dispatch", step=global_step + 1):
+                            params, opt_state, m = train_step(
+                                params, opt_state, x, y, rng, global_step
+                            )
                     global_step += 1
                     step_in_epoch += 1
                     # Device-side double-buffered prefetch (--device_prefetch):
@@ -1585,16 +1681,17 @@ def main(argv: list[str] | None = None) -> None:
                         )
                     ):
                         try:
-                            while len(micro) < args.grad_accum_steps:
-                                xb, yb = next(loader_iter)
-                                micro.append((xb, yb))
-                            prefetched_dev = shard_batch(
-                                (
-                                    np.stack([m[0] for m in micro]),
-                                    np.stack([m[1] for m in micro]),
-                                ),
-                                mesh,
-                            )
+                            with tracer.span("h2d_prefetch"):
+                                while len(micro) < args.grad_accum_steps:
+                                    xb, yb = next(loader_iter)
+                                    micro.append((xb, yb))
+                                prefetched_dev = shard_batch(
+                                    (
+                                        np.stack([m[0] for m in micro]),
+                                        np.stack([m[1] for m in micro]),
+                                    ),
+                                    mesh,
+                                )
                         except StopIteration:
                             pass
                         except RuntimeError as exc:
@@ -1611,6 +1708,10 @@ def main(argv: list[str] | None = None) -> None:
                             )
                     flush_pending()
                     pending = (global_step, epoch, step_in_epoch, m)
+                    # Stop the on-demand capture once the window's last step
+                    # has been FLUSHED (flush_pending blocked on its metrics,
+                    # so its device work is in the trace, not just queued).
+                    xla_capture.maybe_stop(global_step - 1)
                     if watchdog is not None:
                         # Arm-as-beat: the deadline extends only when a step
                         # completes, and the watchdog goes live only after the
@@ -1629,10 +1730,11 @@ def main(argv: list[str] | None = None) -> None:
                             watchdog.disarm()  # eval has no step cadence
                         # count_tokens=False: this step's training update
                         # already counted its tokens; eval is out-of-band.
-                        tracker.update(
-                            global_step, count_tokens=False,
-                            eval_loss=run_eval(params),
-                        )
+                        with tracer.span("eval", step=global_step):
+                            tracker.update(
+                                global_step, count_tokens=False,
+                                eval_loss=run_eval(params),
+                            )
                         if watchdog is not None:
                             watchdog.arm()
                     if (
@@ -1725,6 +1827,7 @@ def main(argv: list[str] | None = None) -> None:
                     if args.max_steps and global_step >= args.max_steps:
                         done = True
                         break
+                end_step_span()
                 loader_iter.close()  # stop worker threads promptly
                 if multihost:
                     # Epoch/run boundary barrier: a fault flag raised by the
@@ -1755,6 +1858,9 @@ def main(argv: list[str] | None = None) -> None:
                     monitor.reset()
                 guard_state = init_guard_state()
                 rollbacks_done += 1
+                tracer.event(
+                    "rollback", step=global_step, count=rollbacks_done
+                )
                 if rollbacks_done > args.max_rollbacks:
                     tracker.close()
                     stop_aux()

@@ -70,6 +70,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+from gpt_2_distributed_tpu.obs.trace import (  # noqa: E402 — needs REPO path
+    XlaCapture,
+    configure_tracing,
+    get_tracer,
+    parse_profile_at,
+)
+
+# Inert until main() arms it from --xla_profile_at; one capture window per
+# bench process (the first replay that reaches the armed step wins).
+_XLA_CAPTURE = XlaCapture(None, None)
+
 
 def build_argparser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -136,6 +147,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="run only the one-shot comparison (engine debug)")
     p.add_argument("--json", default="BENCH_SERVE.json", metavar="PATH",
                    help="result file ('' disables the write)")
+    p.add_argument("--trace_dir", default=None,
+                   help="write span/event trace JSONL here (obs/trace.py)")
+    p.add_argument("--xla_profile_at", default=None, metavar="STEP[:NSTEPS]",
+                   help="capture an XLA profiler trace covering NSTEPS "
+                        "(default 1) engine steps starting at STEP of the "
+                        "first measured replay; needs --trace_dir")
     return p
 
 
@@ -168,6 +185,15 @@ def validate_args(p: argparse.ArgumentParser, args: argparse.Namespace) -> None:
         p.error(f"--watermark_blocks {args.watermark_blocks}: must be >= 0")
     if args.repeats < 1:
         p.error(f"--repeats {args.repeats}: need at least one measurement")
+    if args.xla_profile_at is not None:
+        from gpt_2_distributed_tpu.obs.trace import parse_profile_at
+
+        try:
+            parse_profile_at(args.xla_profile_at)
+        except ValueError as e:
+            p.error(str(e))
+        if not args.trace_dir:
+            p.error("--xla_profile_at needs --trace_dir for output")
 
 
 def percentiles(xs, np):
@@ -281,6 +307,7 @@ def run_engine(args, params, config, serve, trace, jax, np, make_engine):
         t0 = time.monotonic()
         handles = []
         nxt = 0
+        step_no = 0
         while nxt < n or eng._queue or eng._has_active():
             now = time.monotonic() - t0
             while nxt < n and arrivals[nxt] <= now:
@@ -289,7 +316,10 @@ def run_engine(args, params, config, serve, trace, jax, np, make_engine):
                     on_token=on_token,
                 ))
                 nxt += 1
+            _XLA_CAPTURE.maybe_start(step_no + 1)
             stepped = eng.step()
+            step_no += 1
+            _XLA_CAPTURE.maybe_stop(step_no)
             if (stepped == 0 and not eng._has_active() and not eng._queue
                     and nxt < n):
                 # Truly idle: nothing in flight, nothing queued — wait for
@@ -360,6 +390,12 @@ def main(argv=None) -> None:
     from gpt_2_distributed_tpu.models import gpt2
     from gpt_2_distributed_tpu.models.decode import generate_cached
     from gpt_2_distributed_tpu.serving import ServingEngine
+
+    global _XLA_CAPTURE
+    if args.trace_dir:
+        configure_tracing(args.trace_dir)
+    _XLA_CAPTURE = XlaCapture(parse_profile_at(args.xla_profile_at),
+                              args.trace_dir)
 
     overrides = {
         k: getattr(args, k)
@@ -488,6 +524,8 @@ def main(argv=None) -> None:
                 )
         result["traces"][name] = sec
 
+    _XLA_CAPTURE.stop_if_active()
+    get_tracer().close()
     if args.json:
         with open(args.json, "w") as f:
             json.dump(result, f, indent=1)

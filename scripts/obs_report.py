@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Merge per-process trace files and report where the time went.
+
+Reads every ``trace-p*.jsonl`` (plus ``.1`` rotation generations) under a
+trace directory written by ``gpt_2_distributed_tpu.obs.trace`` and prints:
+
+* **Per-phase step breakdown** — for each ``step`` span, its direct child
+  spans (data_fetch, consensus_exchange, step_dispatch, h2d_prefetch,
+  device_sync, collector, ckpt_snapshot, ...) summed by name; p50/p99/mean
+  per phase, each phase's share of mean step time, and the **unattributed
+  residual** (step wall time minus the sum of its children) — the honest
+  number an MFU-gap hunt starts from. Attribution % is printed, never
+  hidden: if instrumentation misses a phase, the residual says so.
+* **Per-request serving waterfall** — lifecycle events keyed by request id
+  (submit, admit, prefill_chunk, prefix_hit, cow, preempt, resume,
+  first_token, finish) folded into queue-wait / TTFT / total latency per
+  request, plus pool-level p50/p99 TTFT. TTFT here is rebuilt purely from
+  trace events; the engine stamps those events with its own monotonic
+  timestamps, so this agrees with the engine's accounting to the
+  microsecond.
+* **Engine-step breakdown** — same treatment for ``engine_step`` spans
+  (admit / prefill / decode phases of the continuous-batching loop).
+
+``--json`` emits the same content as one JSON object for dashboards.
+
+Usage:
+    python scripts/obs_report.py /path/to/trace_dir [--json] [--limit N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank-interpolated percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q / 100.0 * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _stats_ms(vals: list[float]) -> dict[str, float]:
+    s = sorted(vals)
+    return {
+        "n": len(s),
+        "mean_ms": sum(s) / len(s) * 1e3 if s else 0.0,
+        "p50_ms": _percentile(s, 50) * 1e3,
+        "p99_ms": _percentile(s, 99) * 1e3,
+        "total_s": sum(s),
+    }
+
+
+def load_trace_dir(trace_dir: str) -> list[dict[str, Any]]:
+    """All records from every process file, rotations included (oldest
+    first so later analysis sees records roughly in emission order)."""
+    paths = sorted(glob.glob(os.path.join(trace_dir, "trace-p*.jsonl.1"))) + sorted(
+        glob.glob(os.path.join(trace_dir, "trace-p*.jsonl"))
+    )
+    if not paths:
+        raise FileNotFoundError(f"no trace-p*.jsonl files under {trace_dir!r}")
+    records: list[dict[str, Any]] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail line from a crash — expected
+    return records
+
+
+def step_breakdown(
+    records: list[dict[str, Any]], step_name: str = "step"
+) -> dict[str, Any] | None:
+    """Fold each ``step_name`` span's direct children into per-phase stats.
+
+    Only *direct* children are summed — a nested span (e.g. a barrier
+    inside consensus_exchange) is already inside its parent's duration, so
+    counting it again would overstate attribution.
+    """
+    spans = [r for r in records if r.get("ph") == "span"]
+    by_key = {(r["pid"], r["sid"]): r for r in spans}
+    steps = [r for r in spans if r["name"] == step_name]
+    if not steps:
+        return None
+    children: dict[tuple[int, int], list[dict[str, Any]]] = defaultdict(list)
+    for r in spans:
+        if r.get("parent") is not None:
+            parent = by_key.get((r["pid"], r["parent"]))
+            if parent is not None:
+                children[(r["pid"], r["parent"])].append(r)
+
+    phase_durs: dict[str, list[float]] = defaultdict(list)
+    step_durs: list[float] = []
+    residuals: list[float] = []
+    for st in steps:
+        kids = children.get((st["pid"], st["sid"]), [])
+        attributed = 0.0
+        per_phase: dict[str, float] = defaultdict(float)
+        for k in kids:
+            per_phase[k["name"]] += k["dur"]
+            attributed += k["dur"]
+        for name, d in per_phase.items():
+            phase_durs[name].append(d)
+        step_durs.append(st["dur"])
+        residuals.append(max(0.0, st["dur"] - attributed))
+
+    total_step = sum(step_durs)
+    total_attr = total_step - sum(residuals)
+    phases = {
+        name: {
+            **_stats_ms(durs),
+            "share_pct": 100.0 * sum(durs) / total_step if total_step else 0.0,
+        }
+        for name, durs in sorted(
+            phase_durs.items(), key=lambda kv: -sum(kv[1])
+        )
+    }
+    return {
+        "span": step_name,
+        "n_steps": len(step_durs),
+        "processes": sorted({s["pid"] for s in steps}),
+        "step": _stats_ms(step_durs),
+        "phases": phases,
+        "residual": {
+            **_stats_ms(residuals),
+            "share_pct": 100.0 * sum(residuals) / total_step if total_step else 0.0,
+        },
+        "attributed_pct": 100.0 * total_attr / total_step if total_step else 0.0,
+    }
+
+
+# Lifecycle events that mark a request's trajectory, in waterfall order.
+_REQUEST_EVENTS = (
+    "submit",
+    "admit",
+    "prefix_hit",
+    "cow",
+    "prefill_chunk",
+    "first_token",
+    "preempt",
+    "resume",
+    "finish",
+)
+
+
+def request_waterfall(records: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Rebuild each serving request's lifecycle from its rid-keyed events."""
+    by_rid: dict[Any, list[dict[str, Any]]] = defaultdict(list)
+    for r in records:
+        if r.get("ph") == "event" and "rid" in r.get("attrs", {}):
+            by_rid[r["attrs"]["rid"]].append(r)
+    if not by_rid:
+        return None
+
+    requests = []
+    ttfts: list[float] = []
+    for rid, evs in sorted(by_rid.items(), key=lambda kv: str(kv[0])):
+        evs.sort(key=lambda e: e["ts"])
+        first_ts = {}
+        counts: dict[str, int] = defaultdict(int)
+        for e in evs:
+            counts[e["name"]] += 1
+            first_ts.setdefault(e["name"], e["ts"])
+        t_submit = first_ts.get("submit")
+        row: dict[str, Any] = {"rid": rid, "events": dict(counts)}
+        if t_submit is not None:
+            for name in ("admit", "first_token", "finish"):
+                if name in first_ts:
+                    row[f"{name}_ms"] = (first_ts[name] - t_submit) * 1e3
+            if "first_token" in first_ts:
+                ttfts.append(first_ts["first_token"] - t_submit)
+        # Cached/chunked prefill details when the engine attached them.
+        for e in evs:
+            a = e.get("attrs", {})
+            if e["name"] == "prefix_hit" and "tokens" in a:
+                row["prefix_cached_tokens"] = a["tokens"]
+            if e["name"] == "finish" and "n_generated" in a:
+                row["n_generated"] = a["n_generated"]
+        requests.append(row)
+
+    return {
+        "n_requests": len(requests),
+        "ttft": _stats_ms(ttfts) if ttfts else None,
+        "requests": requests,
+    }
+
+
+def build_report(trace_dir: str) -> dict[str, Any]:
+    records = load_trace_dir(trace_dir)
+    return {
+        "trace_dir": trace_dir,
+        "n_records": len(records),
+        "train_steps": step_breakdown(records, "step"),
+        "engine_steps": step_breakdown(records, "engine_step"),
+        "serving": request_waterfall(records),
+    }
+
+
+def _print_breakdown(b: dict[str, Any], title: str) -> None:
+    print(f"\n== {title}: {b['n_steps']} spans over "
+          f"process(es) {b['processes']} ==")
+    st = b["step"]
+    print(f"  step wall: mean {st['mean_ms']:.2f} ms, p50 {st['p50_ms']:.2f}, "
+          f"p99 {st['p99_ms']:.2f}  (total {st['total_s']:.2f} s)")
+    print(f"  {'phase':<20} {'mean_ms':>9} {'p50_ms':>9} {'p99_ms':>9} "
+          f"{'share':>7} {'n':>5}")
+    for name, ph in b["phases"].items():
+        print(f"  {name:<20} {ph['mean_ms']:>9.2f} {ph['p50_ms']:>9.2f} "
+              f"{ph['p99_ms']:>9.2f} {ph['share_pct']:>6.1f}% {ph['n']:>5}")
+    res = b["residual"]
+    print(f"  {'(unattributed)':<20} {res['mean_ms']:>9.2f} {res['p50_ms']:>9.2f} "
+          f"{res['p99_ms']:>9.2f} {res['share_pct']:>6.1f}%")
+    print(f"  attributed: {b['attributed_pct']:.1f}% of step wall time")
+
+
+def _print_serving(s: dict[str, Any], limit: int) -> None:
+    print(f"\n== serving: {s['n_requests']} requests ==")
+    if s["ttft"]:
+        t = s["ttft"]
+        print(f"  TTFT: mean {t['mean_ms']:.2f} ms, p50 {t['p50_ms']:.2f}, "
+              f"p99 {t['p99_ms']:.2f}  (n={t['n']})")
+    print(f"  {'rid':<14} {'admit_ms':>9} {'ttft_ms':>9} {'finish_ms':>10} "
+          f"{'chunks':>6} {'preempt':>7} {'cached':>6}")
+    for row in s["requests"][:limit]:
+        ev = row["events"]
+        print(
+            f"  {str(row['rid']):<14} "
+            f"{row.get('admit_ms', float('nan')):>9.2f} "
+            f"{row.get('first_token_ms', float('nan')):>9.2f} "
+            f"{row.get('finish_ms', float('nan')):>10.2f} "
+            f"{ev.get('prefill_chunk', 0):>6} "
+            f"{ev.get('preempt', 0):>7} "
+            f"{row.get('prefix_cached_tokens', 0):>6}"
+        )
+    if len(s["requests"]) > limit:
+        print(f"  ... {len(s['requests']) - limit} more (raise --limit)")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("trace_dir", help="directory holding trace-p*.jsonl files")
+    ap.add_argument("--json", action="store_true", help="emit one JSON object")
+    ap.add_argument("--limit", type=int, default=40,
+                    help="max per-request rows to print (text mode)")
+    args = ap.parse_args(argv)
+
+    try:
+        report = build_report(args.trace_dir)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+        return 0
+
+    print(f"trace dir: {report['trace_dir']}  ({report['n_records']} records)")
+    if report["train_steps"]:
+        _print_breakdown(report["train_steps"], "training step breakdown")
+    if report["engine_steps"]:
+        _print_breakdown(report["engine_steps"], "serving engine-step breakdown")
+    if report["serving"]:
+        _print_serving(report["serving"], args.limit)
+    if not any((report["train_steps"], report["engine_steps"], report["serving"])):
+        print("no step spans or request events found — was tracing enabled?")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,8 +159,32 @@ class TestTracker:
 
     def test_unknown_metric_ignored(self):
         t = make_tracker()
-        t.update(1, loss=1.0, bogus_metric=5.0)
+        with pytest.warns(UserWarning, match="bogus_metric"):
+            t.update(1, loss=1.0, bogus_metric=5.0)
         assert "bogus_metric" not in t.buffers
+
+    def test_unknown_metric_counted_and_warned_once(self):
+        import warnings
+
+        t = make_tracker()
+        with pytest.warns(UserWarning, match="unregistered metric 'bogus'"):
+            t.update(1, loss=1.0, bogus=5.0)
+        # repeat pushes still counted, but never warn again
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            t.update(2, loss=1.0, bogus=6.0)
+            t.update(3, loss=1.0, bogus=7.0)
+        assert t.dropped_metrics == {"bogus": 3}
+        # registered metrics were never affected
+        assert len(t.buffers["loss"]) == 3
+
+    def test_strict_mode_raises_on_unregistered(self):
+        t = make_tracker(strict=True)
+        with pytest.raises(KeyError, match="never registered"):
+            t.update(1, loss=1.0, bogus=5.0)
+        # the registered metrics in the same call may or may not have been
+        # buffered (dict order) — what matters is nothing was dropped quietly
+        assert t.dropped_metrics == {}
 
     def test_tensorboard_event_files_written(self, tmp_path):
         t = make_tracker(tmp_path, tb_every=1)
@@ -182,3 +206,107 @@ class TestTracker:
         t.start_epoch(3)
         assert t.current_epoch == 3
         assert t.window_tokens == 0
+
+
+class _FakeWriter:
+    """Records add_scalar calls; stands in for the TB SummaryWriter."""
+
+    def __init__(self):
+        self.scalars = []
+
+    def add_scalar(self, tag, value, step):
+        self.scalars.append((tag, value, step))
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class TestOutOfBandCadence:
+    """Regression: ``count_tokens=False`` updates used to TB-write on EVERY
+    call, ignoring ``tb_every`` — a serving sink flushing each engine step
+    or a tight eval cadence would spam the event file."""
+
+    def test_out_of_band_honors_tb_every(self):
+        t = make_tracker(tb_every=3)
+        t.writer = _FakeWriter()
+        t.update(1, count_tokens=False, eval_loss=4.0)
+        t.update(2, count_tokens=False, eval_loss=3.0)
+        assert t.writer.scalars == []  # off-cadence: buffered, not written
+        t.update(3, count_tokens=False, eval_loss=2.0)
+        assert [s for s in t.writer.scalars if s[0] == "eval/eval_loss"] == [
+            ("eval/eval_loss", 2.0, 3)  # CURRENT reduction over the window
+        ]
+
+    def test_out_of_band_never_counts_tokens(self):
+        t = make_tracker(tb_every=1)
+        t.writer = _FakeWriter()
+        t.update(1, loss=1.0)
+        tokens_after_step = t.total_tokens
+        t.update(1, count_tokens=False, eval_loss=2.0)
+        assert t.total_tokens == tokens_after_step
+
+
+class TestDistReduceRouting:
+    """``_default_reduce`` combines each metric by its declared
+    ``dist_reduce`` — counters sum, high-water marks max, gauges mean."""
+
+    def test_routes_by_declared_strategy(self, monkeypatch):
+        import numpy as np
+
+        import jax
+        from jax.experimental import multihost_utils
+
+        from gpt_2_distributed_tpu.metrics.tracker import _default_reduce
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda arr: np.stack([arr, arr]),  # both hosts pushed the same
+        )
+        out = _default_reduce({
+            "skipped_steps": 3.0,     # dist_reduce="sum"
+            "desync_detected": 2.0,   # dist_reduce="max"
+            "loss": 4.0,              # default mean
+        })
+        assert out["skipped_steps"] == 6.0
+        assert out["desync_detected"] == 2.0
+        assert out["loss"] == pytest.approx(4.0)
+
+    def test_unknown_key_falls_back_to_mean(self, monkeypatch):
+        import numpy as np
+
+        import jax
+        from jax.experimental import multihost_utils
+
+        from gpt_2_distributed_tpu.metrics.tracker import _default_reduce
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda arr: np.stack([arr, 3 * arr]),
+        )
+        out = _default_reduce({"not_registered": 1.0})
+        assert out["not_registered"] == pytest.approx(2.0)
+
+    def test_single_process_identity(self):
+        from gpt_2_distributed_tpu.metrics.tracker import _default_reduce
+
+        vals = {"loss": 1.5, "skipped_steps": 2.0}
+        assert _default_reduce(vals) == vals
+
+    def test_dist_reduce_validation(self):
+        with pytest.raises(ValueError, match="dist_reduce"):
+            MetricDefinition(name="bad", dist_reduce="median")
+
+    def test_builtin_counter_declarations(self):
+        # the conditional-push counters declare their combine explicitly
+        for name, want in (
+            ("skipped_steps", "sum"), ("clipped_steps", "sum"),
+            ("save_failures", "sum"), ("data_read_retries", "sum"),
+            ("desync_detected", "max"), ("preempted", "sum"),
+            ("prefix_cached_tokens", "sum"), ("loss", "mean"),
+        ):
+            assert METRIC_REGISTRY.get(name).dist_reduce == want, name
