@@ -231,13 +231,16 @@ def collect_memory(tracker: "StatsTracker") -> dict[str, float]:
     return out
 
 
-# --- serving-load metrics (pushed by serving/serve.py's --tb_dir sink) -----
+# --- serving-load metrics (pushed by the serving --tb_dir sink) ------------
 # TB-only (cli_format None): the serving CLI's stderr summary already
 # narrates totals; these exist so a deployment's TensorBoard sees load —
 # queue depth/wait and occupancy size the deployment, preemption count and
 # prefix-hit volume judge the ServeConfig scheduler knobs. All CURRENT:
-# each flush pushes the engine's metrics_snapshot() as-of-now (wait is a
-# running mean, preempted/prefix tokens are cumulative counters).
+# each flush pushes the fleet's metrics_snapshot() as-of-now (wait is a
+# running mean, preempted/prefix tokens are cumulative counters). Both
+# entry points (gpt2-tpu-serve, gpt2-tpu-frontend) emit through the same
+# EngineDriver, so one replica or a routed fleet writes the same names;
+# the last four are fleet-level (serving/frontend/router.py).
 
 for _name, _dist in (
     ("queue_wait_ms", "mean"),         # mean enqueue->admission gap per admission
@@ -245,6 +248,10 @@ for _name, _dist in (
     ("prefix_cached_tokens", "sum"),   # cumulative prompt tokens served from cache
     ("serve_queue_depth", "sum"),      # requests waiting for a slot, as of the flush
     ("serve_occupancy", "sum"),        # occupied decode slots, as of the flush
+    ("serve_replicas", "sum"),         # active engine replicas, as of the flush
+    ("serve_shed", "sum"),             # cumulative SLO-admission refusals (503s)
+    ("route_affinity_hits", "sum"),    # cumulative prefix-affinity route decisions
+    ("slo_violations", "sum"),         # cumulative finished requests over TTFT SLO
 ):
     METRIC_REGISTRY.metric(
         _name, reduction=ReductionStrategy.CURRENT, tb_prefix="serve/",

@@ -331,10 +331,23 @@ class PreemptionHandler:
     emergency checkpoint at the next step boundary, then exits
     :data:`PREEMPTED_EXIT_CODE` for ``supervise.sh`` to relaunch with
     ``--resume``.
+
+    The serving stack reuses the same flag for graceful drain (SIGTERM to
+    ``gpt2-tpu-serve`` / ``gpt2-tpu-frontend`` finishes in-flight requests,
+    rejects new submits, exits 0) — ``notice`` swaps the announcement for
+    one that matches what the driver will actually do.
     """
 
-    def __init__(self, signals: tuple[int, ...] = (signal.SIGTERM,)) -> None:
+    def __init__(
+        self,
+        signals: tuple[int, ...] = (signal.SIGTERM,),
+        notice: str | None = None,
+    ) -> None:
         self.signals = signals
+        self.notice = notice or (
+            f"will save an emergency checkpoint and exit "
+            f"{PREEMPTED_EXIT_CODE} at the next step boundary"
+        )
         self._flag = False
         self._prev: dict[int, object] = {}
 
@@ -352,11 +365,7 @@ class PreemptionHandler:
             from gpt_2_distributed_tpu.obs.trace import get_tracer
 
             get_tracer().event("preempt_notice", reason=reason)
-            print(
-                f"[preempt] {reason}; will save an emergency checkpoint and "
-                f"exit {PREEMPTED_EXIT_CODE} at the next step boundary",
-                flush=True,
-            )
+            print(f"[preempt] {reason}; {self.notice}", flush=True)
 
     def install(self) -> "PreemptionHandler":
         """Install handlers (main thread only — the signal-module contract);

@@ -125,6 +125,7 @@ class RequestHandle:
         self.preemptions = 0         # times swapped out for pool pressure
         self.resumes = 0             # re-admissions after a preemption
         self.prefix_cached_tokens = 0  # prompt tokens skipped at 1st admission
+        self.replica: int | None = None  # set by the replica router on route
         self._key = None        # [2] uint32 PRNG chain head
         self._slot: int | None = None
         self._blocks: list[int] | None = None
@@ -471,10 +472,16 @@ class ServingEngine:
         *,
         rng: jax.Array | int = 0,
         on_token: Callable[[RequestHandle, int], None] | None = None,
+        rid: int | None = None,
     ) -> RequestHandle:
         """Queue a request. Validation happens HERE (the admission gate),
         with the same ``check_generation_args`` ValueErrors as both decode
         paths — a request the one-shot sampler would reject never enqueues.
+
+        ``rid`` overrides the engine-local id counter: the replica router
+        assigns FLEET-unique ids so trace events and API response ids from
+        different replicas can never collide. Single-engine callers leave
+        it None and get the engine counter (0, 1, 2, ... in submit order).
         """
         prompt = [int(t) for t in prompt]
         check_generation_args(
@@ -490,8 +497,10 @@ class ServingEngine:
             )
         if isinstance(rng, int):
             rng = jax.random.PRNGKey(rng)
-        req = RequestHandle(self._next_id, prompt, max_new_tokens, on_token)
-        self._next_id += 1
+        if rid is None:
+            rid = self._next_id
+            self._next_id += 1
+        req = RequestHandle(rid, prompt, max_new_tokens, on_token)
         req._key = np.asarray(rng, np.uint32)
         req.submit_time = time.monotonic()
         req._enqueue_time = req.submit_time
@@ -847,6 +856,26 @@ class ServingEngine:
 
     def _has_active(self) -> bool:
         return any(s is not None for s in self._slots)
+
+    def has_work(self) -> bool:
+        """Anything queued or in flight — the driver's step/skip gate."""
+        return bool(self._queue) or self._has_active()
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests accepted but not yet admitted to a slot."""
+        return len(self._queue)
+
+    @property
+    def occupancy(self) -> int:
+        """Occupied decode slots (prefilling rows included)."""
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def prefix_cache(self) -> PrefixCache | None:
+        """The engine's prefix cache (None when ``serve.prefix_cache`` is
+        off) — the router's affinity probe reads it, never writes."""
+        return self._cache
 
     def step(self) -> int:
         """One engine step: admit what fits, advance one prefill chunk

@@ -1,0 +1,30 @@
+"""The serving front door: HTTP/SSE server, replica router, autoscaler,
+and the shared engine-driver both serving entry points run on.
+
+Layering (each importable alone; the server composes all of them)::
+
+    server.py     asyncio HTTP front end (`gpt2-tpu-frontend`)
+    driver.py     the ONE submit/step/drain loop (also used by serve.py)
+    autoscale.py  grow/shrink decisions from queue-depth + SLO signals
+    router.py     prefix-affinity routing + SLO-aware admission
+"""
+
+from gpt_2_distributed_tpu.serving.frontend.autoscale import Autoscaler
+from gpt_2_distributed_tpu.serving.frontend.driver import (
+    DrainingError,
+    EngineDriver,
+)
+from gpt_2_distributed_tpu.serving.frontend.router import (
+    ROUTE_POLICIES,
+    ReplicaRouter,
+    ShedError,
+)
+
+__all__ = [
+    "Autoscaler",
+    "DrainingError",
+    "EngineDriver",
+    "ROUTE_POLICIES",
+    "ReplicaRouter",
+    "ShedError",
+]

@@ -1,0 +1,225 @@
+"""The shared engine-driver: ONE submit/step/drain loop behind both
+serving entry points.
+
+``gpt2-tpu-serve`` (JSONL over stdin) and ``gpt2-tpu-frontend`` (HTTP/SSE)
+used to be one step loop and one hypothetical one; two hand-rolled loops
+over the same engine is exactly how entry points drift (different metrics
+cadence, different capture windows, different drain semantics). This class
+is the single loop both wrap:
+
+* **submit** — route through the :class:`ReplicaRouter` (which may shed),
+  rejecting everything once draining has begun (:class:`DrainingError`,
+  a 503 at the HTTP layer). ``submit_threadsafe`` is the same thing
+  callable from any thread (the asyncio server's executor-free bridge):
+  submissions park in an inbox the driver thread consumes at the next
+  step boundary, because the engine's host-side scheduler state is
+  single-threaded by design.
+* **step** — one tick of the fleet: consume the inbox, step every engine
+  with work (retired replicas drain through here too), tick the
+  autoscaler, run finish callbacks + SLO accounting, flush the metrics
+  sink every ``metrics_every`` steps, and honor the XLA capture window —
+  the exact cadence ``serve.py`` had inline, now shared.
+* **drain** — run to idle (the JSONL path's whole life; the HTTP path's
+  SIGTERM epilogue). Graceful shutdown reuses the resilience SIGTERM
+  flag (:class:`resilience.PreemptionHandler`): the driver polls
+  ``preempted()`` at step boundaries — the same boundary-checked contract
+  as training — and flips to ``draining``: in-flight requests run to
+  completion, new submits are refused, and the caller exits 0.
+
+The JSONL path's byte-identity is preserved: with one replica and no
+frontend feature enabled, the driver's step ordering, capture points and
+metric flushes replay ``serve.py``'s original loop exactly.
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import threading
+from typing import Callable, Sequence
+
+from gpt_2_distributed_tpu.serving.engine import RequestHandle
+from gpt_2_distributed_tpu.serving.frontend.router import ReplicaRouter
+
+
+class DrainingError(RuntimeError):
+    """Submit refused: the driver is draining toward shutdown."""
+
+
+class EngineDriver:
+    """Owns the step loop over a :class:`ReplicaRouter` fleet."""
+
+    def __init__(
+        self,
+        router: ReplicaRouter,
+        *,
+        tracker=None,
+        metrics_every: int = 20,
+        xla_capture=None,
+        preemption=None,
+        autoscaler=None,
+        autoscale_every: int = 1,
+    ):
+        self.router = router
+        self.tracker = tracker
+        self.metrics_every = max(int(metrics_every), 1)
+        self.xla_capture = xla_capture
+        self.preemption = preemption
+        self.autoscaler = autoscaler
+        self.autoscale_every = max(int(autoscale_every), 1)
+        self.steps = 0
+        self.draining = False
+        self._watch: list[tuple[RequestHandle, Callable | None]] = []
+        self._inbox: collections.deque = collections.deque()
+        self._wake = threading.Event()
+        self._stop = False
+        self._finished = False
+
+    # ------------------------------------------------------------- intake
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        *,
+        rng=0,
+        on_token: Callable[[RequestHandle, int], None] | None = None,
+        on_finish: Callable[[RequestHandle], None] | None = None,
+    ) -> RequestHandle:
+        """Driver-thread submit. Raises :class:`DrainingError` once
+        shutdown has begun, :class:`ShedError` from SLO admission, and
+        ``ValueError`` for requests the engine itself would refuse."""
+        if self.draining:
+            raise DrainingError(
+                "draining: in-flight requests are completing; no new "
+                "submits accepted"
+            )
+        handle = self.router.submit(
+            prompt, max_new_tokens, rng=rng, on_token=on_token,
+        )
+        self._watch.append((handle, on_finish))
+        return handle
+
+    def submit_threadsafe(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        *,
+        rng=0,
+        on_token: Callable[[RequestHandle, int], None] | None = None,
+        on_finish: Callable[[RequestHandle], None] | None = None,
+    ) -> concurrent.futures.Future:
+        """Cross-thread submit: resolves to the :class:`RequestHandle` at
+        the driver's next step boundary, or to the refusal exception."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        if self._finished:
+            # The loop already exited: nothing will ever drain the inbox.
+            fut.set_exception(DrainingError(
+                "draining: the engine loop has exited"
+            ))
+            return fut
+        self._inbox.append(
+            (fut, list(prompt), max_new_tokens, rng, on_token, on_finish)
+        )
+        self._wake.set()
+        return fut
+
+    def _consume_inbox(self) -> None:
+        while self._inbox:
+            fut, prompt, new, rng, on_token, on_finish = self._inbox.popleft()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(self.submit(
+                    prompt, new, rng=rng,
+                    on_token=on_token, on_finish=on_finish,
+                ))
+            except BaseException as e:  # refusals travel to the caller
+                fut.set_exception(e)
+
+    # --------------------------------------------------------------- loop
+
+    def _check_preemption(self) -> None:
+        if (not self.draining and self.preemption is not None
+                and self.preemption.preempted()):
+            self.begin_drain()
+
+    def begin_drain(self) -> None:
+        """Stop accepting work; everything already accepted completes."""
+        self.draining = True
+
+    def has_work(self) -> bool:
+        return bool(self._inbox) or self.router.has_work()
+
+    def step(self) -> int:
+        """One fleet tick; returns tokens emitted. Mirrors serve.py's
+        original per-step ordering: capture start -> engine step(s) ->
+        capture stop -> metrics flush."""
+        self._check_preemption()
+        self._consume_inbox()
+        self.steps += 1
+        if self.xla_capture is not None:
+            self.xla_capture.maybe_start(self.steps)
+        emitted = 0
+        for eng in self.router.engines_with_work():
+            emitted += eng.step()
+        if self.xla_capture is not None:
+            self.xla_capture.maybe_stop(self.steps)
+        if (self.autoscaler is not None
+                and self.steps % self.autoscale_every == 0):
+            self.autoscaler.tick()
+        if self._watch:
+            still = []
+            for handle, on_finish in self._watch:
+                if handle.done:
+                    self.router.observe_finish(handle)
+                    if on_finish is not None:
+                        on_finish(handle)
+                else:
+                    still.append((handle, on_finish))
+            self._watch = still
+        tracker = self.tracker
+        if tracker is not None and self.steps % self.metrics_every == 0:
+            tracker.update(self.steps, count_tokens=False,
+                           **self.router.metrics_snapshot())
+        return emitted
+
+    def drain(self) -> int:
+        """Run until the fleet is idle (the JSONL path's main loop and the
+        SIGTERM epilogue). Returns total tokens emitted. Finishes with the
+        final metrics flush and closes any XLA capture window, exactly as
+        serve.py's inline loop did."""
+        total = 0
+        while self.has_work():
+            total += self.step()
+        if self.xla_capture is not None:
+            self.xla_capture.stop_if_active()
+        tracker = self.tracker
+        if tracker is not None:
+            tracker.update(self.steps + 1, count_tokens=False,
+                           **self.router.metrics_snapshot())
+        return total
+
+    def run_forever(self, idle_wait: float = 0.01) -> None:
+        """The HTTP server's driver-thread loop: step while there is work,
+        park on the wake event while idle, exit once draining completes
+        (or ``stop()`` is called and the fleet is idle)."""
+        while True:
+            if self.has_work():
+                self.step()
+                continue
+            self._check_preemption()
+            if self.draining or self._stop:
+                break
+            self._wake.wait(idle_wait)
+            self._wake.clear()
+        # Drain whatever raced in while breaking out.
+        self.draining = True
+        self.drain()
+        self._finished = True
+        self._consume_inbox()  # refuse (DrainingError) anything left parked
+
+    def stop(self) -> None:
+        """Ask ``run_forever`` to exit once idle (tests, clean shutdown)."""
+        self._stop = True
+        self._wake.set()

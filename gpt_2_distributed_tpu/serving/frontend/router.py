@@ -1,0 +1,313 @@
+"""Replica router: prefix-affinity load balancing + SLO-aware admission
+over N :class:`ServingEngine` replicas.
+
+The continuous-batching engine is single-replica by construction (one KV
+pool, one decode program); serving heavy traffic means running several and
+deciding, per request, which one. Two forces pull on that decision:
+
+* **Prefix affinity.** BENCH_SERVE.json's shared-prefix record shows 91.8%
+  of prompt tokens served straight from a replica's prefix cache — but
+  only if the request lands on the replica that *has* the blocks. The
+  router probes every active replica's :class:`PrefixCache` with the
+  request's leading token blocks (``peek_run`` — a read that doesn't
+  touch LRU order or hit counters) and prefers the deepest hit. A
+  hash-keyed *sticky map* (first-block token bytes -> last replica routed)
+  covers the race where the prefix's first carrier is still prefilling
+  (its blocks aren't registered yet) and the prefix-cache-off deployment,
+  where the map alone keeps shared-prefix traffic co-located.
+* **Load.** Affinity ties, cold prefixes, and ``policy="least_loaded"``
+  fall back to the replica with the fewest queued + in-flight requests
+  (ties break to the lowest index, so routing is deterministic for a
+  deterministic submit order). ``policy="round_robin"`` ignores both
+  signals — it exists as the control arm for the affinity benchmark.
+
+SLO-aware admission: with ``queue_slo_ms`` set, the router estimates the
+chosen replica's queue wait (queued requests x an EMA of recent request
+service time / slots) and **sheds** the request (:class:`ShedError`, a 503
+at the HTTP layer) instead of enqueueing work that would blow the target —
+bounded queues are what keep the engine's watermark admission operating in
+its design regime instead of absorbing an unbounded backlog. With
+``ttft_slo_ms`` set, every finished request's measured TTFT is checked
+against the target and violations are counted (``slo_violations``) — the
+autoscaler treats sheds and violations as grow pressure, closing the loop.
+
+Routing changes WHICH replica computes a stream, never WHAT it computes:
+each engine's exactness contract (streams bit-identical to
+``generate_cached(batch=1)``) is per-request and replica-independent, so
+the fleet inherits it unchanged. ``tests/test_frontend.py`` asserts it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence
+
+from gpt_2_distributed_tpu.obs.trace import get_tracer
+from gpt_2_distributed_tpu.serving.engine import RequestHandle, ServingEngine
+
+ROUTE_POLICIES = ("affinity", "least_loaded", "round_robin")
+
+
+class ShedError(RuntimeError):
+    """Request refused by SLO admission — the caller should back off
+    (the HTTP front end maps this to 503 + Retry-After)."""
+
+
+class ReplicaRouter:
+    """Routes submits across engine replicas; owns fleet-level accounting.
+
+    Replicas are created lazily by ``make_engine`` and never destroyed:
+    ``retire`` only deactivates (stops routing to) a replica, keeping its
+    compiled programs warm for the next ``grow`` — the same park-don't-kill
+    economics as the elastic trainer, where a shrunk host's work moves but
+    the binary stays resident. A retired replica keeps stepping until its
+    in-flight requests drain (the driver steps any engine with work).
+    """
+
+    def __init__(
+        self,
+        make_engine: Callable[[], ServingEngine],
+        *,
+        replicas: int = 1,
+        max_replicas: int | None = None,
+        policy: str = "affinity",
+        ttft_slo_ms: float | None = None,
+        queue_slo_ms: float | None = None,
+        service_ms_prior: float = 100.0,
+        rid_start: int = 0,
+    ):
+        if replicas < 1:
+            raise ValueError(f"replicas={replicas} must be >= 1")
+        self.max_replicas = max_replicas if max_replicas is not None else replicas
+        if self.max_replicas < replicas:
+            raise ValueError(
+                f"max_replicas={self.max_replicas} < replicas={replicas}"
+            )
+        if policy not in ROUTE_POLICIES:
+            raise ValueError(
+                f"policy={policy!r}: expected one of {ROUTE_POLICIES}"
+            )
+        if ttft_slo_ms is not None and ttft_slo_ms <= 0:
+            raise ValueError(f"ttft_slo_ms={ttft_slo_ms} must be > 0")
+        if queue_slo_ms is not None and queue_slo_ms <= 0:
+            raise ValueError(f"queue_slo_ms={queue_slo_ms} must be > 0")
+        self._make_engine = make_engine
+        self.policy = policy
+        self.ttft_slo_ms = ttft_slo_ms
+        self.queue_slo_ms = queue_slo_ms
+        self.engines: list[ServingEngine] = []
+        self._active: list[bool] = []
+        self._sticky: dict[bytes, int] = {}
+        self._rr_next = 0
+        # rid_start keeps rids distinct across routers sharing one trace
+        # (bench_serve's measured run vs its round_robin control).
+        self._next_rid = int(rid_start)
+        # EMA of per-request wall time (submit -> finish), seeding the
+        # queue-wait estimate before the first finish lands.
+        self._ema_service_ms = float(service_ms_prior)
+        self.affinity_hits = 0      # routes decided by cache probe / sticky map
+        self.shed_count = 0
+        self.slo_violations = 0
+        self.routed = 0
+        self._prompt_tokens_submitted = 0
+        for _ in range(replicas):
+            self.grow()
+
+    # ------------------------------------------------------------- fleet
+
+    @property
+    def n_active(self) -> int:
+        return sum(self._active)
+
+    def active_indices(self) -> list[int]:
+        return [i for i, a in enumerate(self._active) if a]
+
+    def grow(self) -> int | None:
+        """Activate one replica (reviving a parked one before building a
+        new one); returns its index, or None at ``max_replicas``."""
+        for i, a in enumerate(self._active):
+            if not a:
+                self._active[i] = True
+                get_tracer().event("scale_up", replica=i,
+                                   replicas=self.n_active)
+                return i
+        if len(self.engines) >= self.max_replicas:
+            return None
+        self.engines.append(self._make_engine())
+        self._active.append(True)
+        i = len(self.engines) - 1
+        get_tracer().event("scale_up", replica=i, replicas=self.n_active)
+        return i
+
+    def retire(self) -> int | None:
+        """Deactivate the least-loaded active replica: no new routes land
+        on it, in-flight work drains out through the normal step loop, and
+        its compiled programs stay warm for the next ``grow``. Returns the
+        index, or None when only one replica is active."""
+        idx = self.active_indices()
+        if len(idx) <= 1:
+            return None
+        victim = min(idx, key=lambda i: (self._load(i), i))
+        self._active[victim] = False
+        get_tracer().event("scale_down", replica=victim,
+                           replicas=self.n_active)
+        return victim
+
+    def _load(self, i: int) -> int:
+        eng = self.engines[i]
+        return eng.queue_depth + eng.occupancy
+
+    # ------------------------------------------------------------ routing
+
+    def _sticky_key(self, prompt: Sequence[int]) -> bytes | None:
+        import numpy as np
+
+        bs = self.engines[0].serve.block_size
+        if len(prompt) < bs:
+            return None
+        return np.asarray(prompt[:bs], np.int32).tobytes()
+
+    def _route(self, prompt: Sequence[int]) -> tuple[int, int, str]:
+        """(replica index, affinity blocks, how) for one prompt."""
+        active = self.active_indices()
+        if self.policy == "round_robin":
+            i = active[self._rr_next % len(active)]
+            self._rr_next += 1
+            return i, 0, "round_robin"
+        if self.policy == "affinity":
+            best, best_blocks = [], 0
+            for i in active:
+                cache = self.engines[i].prefix_cache
+                blocks = cache.peek_run(prompt) if cache is not None else 0
+                if blocks > best_blocks:
+                    best, best_blocks = [i], blocks
+                elif blocks == best_blocks and best_blocks > 0:
+                    best.append(i)
+            if best_blocks > 0:
+                return (min(best, key=lambda i: (self._load(i), i)),
+                        best_blocks, "affinity")
+            key = self._sticky_key(prompt)
+            if key is not None:
+                i = self._sticky.get(key)
+                if i is not None and self._active[i]:
+                    return i, 0, "sticky"
+        return min(active, key=lambda i: (self._load(i), i)), 0, "least_loaded"
+
+    def _est_queue_wait_ms(self, i: int) -> float:
+        """Predicted wait for a request joining replica i's queue: queued
+        requests ahead of it, served ``max_batch`` at a time, each batch
+        turning over in roughly one EMA service time."""
+        eng = self.engines[i]
+        return (eng.queue_depth / max(eng.serve.max_batch, 1)) \
+            * self._ema_service_ms
+
+    # ------------------------------------------------------------- submit
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        *,
+        rng=0,
+        on_token: Callable[[RequestHandle, int], None] | None = None,
+    ) -> RequestHandle:
+        """Route + submit one request. Raises :class:`ShedError` when the
+        queue SLO predicts the wait would blow the target, and the same
+        ``ValueError`` as ``ServingEngine.submit`` for invalid requests
+        (bad requests are the CALLER's fault and never counted as sheds).
+        """
+        rid = self._next_rid
+        self._next_rid += 1
+        idx, aff_blocks, how = self._route(prompt)
+        now = time.monotonic()
+        tracer = get_tracer()
+        tracer.event("route", ts=now, rid=rid, replica=idx,
+                     affinity_blocks=aff_blocks, policy=how)
+        if self.queue_slo_ms is not None:
+            est = self._est_queue_wait_ms(idx)
+            if est > self.queue_slo_ms:
+                self.shed_count += 1
+                tracer.event("shed", rid=rid, replica=idx,
+                             est_queue_wait_ms=round(est, 2))
+                raise ShedError(
+                    f"request {rid} shed: predicted queue wait "
+                    f"{est:.0f} ms on replica {idx} exceeds --queue_slo_ms "
+                    f"{self.queue_slo_ms:.0f}"
+                )
+        handle = self.engines[idx].submit(
+            prompt, max_new_tokens, rng=rng, on_token=on_token, rid=rid,
+        )
+        handle.replica = idx
+        if how in ("affinity", "sticky"):
+            self.affinity_hits += 1
+        self.routed += 1
+        self._prompt_tokens_submitted += len(prompt)
+        key = self._sticky_key(prompt)
+        if key is not None:
+            self._sticky[key] = idx
+        return handle
+
+    def observe_finish(self, handle: RequestHandle) -> None:
+        """Fold a finished request into the SLO accounting (the driver
+        calls this once per handle, the step it completes)."""
+        if handle.finish_time is not None and handle.submit_time is not None:
+            wall_ms = (handle.finish_time - handle.submit_time) * 1e3
+            self._ema_service_ms += 0.2 * (wall_ms - self._ema_service_ms)
+        if (
+            self.ttft_slo_ms is not None
+            and handle.first_token_time is not None
+            and (handle.first_token_time - handle.submit_time) * 1e3
+            > self.ttft_slo_ms
+        ):
+            self.slo_violations += 1
+
+    # ------------------------------------------------------------ queries
+
+    def has_work(self) -> bool:
+        return any(e.has_work() for e in self.engines)
+
+    def engines_with_work(self) -> list[ServingEngine]:
+        """Every engine with queued or in-flight requests — retired
+        replicas included, so parked engines still drain."""
+        return [e for e in self.engines if e.has_work()]
+
+    def total_queue_depth(self) -> int:
+        return sum(e.queue_depth for e in self.engines)
+
+    def total_occupancy(self) -> int:
+        return sum(e.occupancy for e in self.engines)
+
+    @property
+    def max_batch(self) -> int:
+        return self.engines[0].serve.max_batch
+
+    def metrics_snapshot(self) -> dict[str, float]:
+        """Fleet-level serving-load metrics; single-replica keys aggregate
+        so the ``--tb_dir`` sink reads the same names either way (each is
+        registered in ``metrics/builtin.py``; the AST check in
+        ``tests/test_metric_registration.py`` resolves this dict)."""
+        admitted = sum(e.stats["admitted"] for e in self.engines)
+        return {
+            "queue_wait_ms": sum(
+                e.stats["queue_wait_ms"] for e in self.engines
+            ) / max(admitted, 1),
+            "preempted": float(
+                sum(e.stats["preemptions"] for e in self.engines)
+            ),
+            "prefix_cached_tokens": float(
+                sum(e.stats["prefix_hit_tokens"] for e in self.engines)
+            ),
+            "serve_queue_depth": float(self.total_queue_depth()),
+            "serve_occupancy": float(self.total_occupancy()),
+            "serve_replicas": float(self.n_active),
+            "serve_shed": float(self.shed_count),
+            "route_affinity_hits": float(self.affinity_hits),
+            "slo_violations": float(self.slo_violations),
+        }
+
+    def aggregate_hit_rate(self) -> float:
+        """Fleet prefix-cache hit rate: prompt tokens served from cache /
+        prompt tokens submitted, across every replica (the number the
+        affinity-vs-round-robin benchmark compares)."""
+        hit = sum(e.stats["prefix_hit_tokens"] for e in self.engines)
+        return hit / max(self._prompt_tokens_submitted, 1)

@@ -131,6 +131,19 @@ class PrefixCache:
 
         return np.asarray(tokens[:end], np.int32).tobytes()
 
+    def peek_run(self, tokens) -> int:
+        """Length (in blocks) of the leading full-block hit run, WITHOUT
+        touching LRU order or the hit/miss counters. The replica router
+        probes every replica's cache with this before choosing one
+        (``serving/frontend/router.py``) — a probe is not a use, so it
+        must not promote entries or skew the cache stats."""
+        run = 0
+        for j in range(len(tokens) // self.block_size):
+            if self._key(tokens, (j + 1) * self.block_size) not in self._entries:
+                break
+            run += 1
+        return run
+
     def lookup(self, tokens) -> list[int]:
         """Longest run of leading full-block hits for this token sequence;
         returns the cached block ids (caller must ``retain`` each before

@@ -1,9 +1,9 @@
 """Serving CLI: run a batch of requests through the continuous-batching
 engine, streaming results as JSON lines.
 
-Offline-first by design (no server socket — the engine is the product;
-wrapping it in a transport is deployment-specific): requests come from a
-JSONL file or stdin, one object per line::
+Offline-first by design (no server socket — for the network front door see
+``gpt2-tpu-frontend``, which wraps the same engine-driver in an HTTP/SSE
+server): requests come from a JSONL file or stdin, one object per line::
 
     {"prompt_ids": [464, 3616], "new": 64, "seed": 7}
     {"prompt": "The meaning of life", "new": 32}
@@ -27,6 +27,12 @@ load (queue depth/wait, occupancy, preemptions, prefix hits) to
 TensorBoard through the shared StatsTracker every ``--metrics_every``
 engine steps.
 
+The step loop itself lives in ``serving/frontend/driver.py`` — ONE
+submit/step/drain loop shared with the HTTP front end, so the two entry
+points cannot drift. SIGTERM drains: in-flight requests run to
+completion (reusing the resilience preemption flag), then the process
+exits 0 — kill -9 is the only way to drop a stream.
+
 Usage::
 
     gpt2-tpu-serve --ckpt runs/ckpt --requests reqs.jsonl --stream
@@ -46,10 +52,11 @@ import sys
 import time
 
 
-def build_argparser() -> argparse.ArgumentParser:
+def add_model_flags(p: argparse.ArgumentParser) -> None:
+    """Model/checkpoint selection flags, shared verbatim with
+    ``gpt2-tpu-frontend`` (serving/frontend/server.py)."""
     from gpt_2_distributed_tpu.config import MODEL_PRESETS
 
-    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--ckpt", default=None,
                    help="checkpoint dir (step_NNNNNNN) or save dir (latest)")
     p.add_argument("--init_random", action="store_true",
@@ -60,12 +67,14 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--n_head", type=int, default=None)
     p.add_argument("--vocab_size", type=int, default=None)
     p.add_argument("--seq_len", type=int, default=None)
-    p.add_argument("--requests", required=True,
-                   help="JSONL request file, or '-' for stdin")
+
+
+def add_engine_flags(p: argparse.ArgumentParser) -> None:
+    """ServeConfig + sampling flags, shared with the front end."""
     p.add_argument("--new", type=int, default=64,
-                   help="default max_new_tokens for lines without 'new'")
+                   help="default max_new_tokens for requests without one")
     p.add_argument("--seed", type=int, default=0,
-                   help="default sampling seed for lines without 'seed'")
+                   help="default sampling seed for requests without one")
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top_k", type=int, default=None)
     p.add_argument("--eos", type=int, default=None,
@@ -86,8 +95,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    "lazy growth with preemption under pool pressure")
     p.add_argument("--watermark_blocks", type=int, default=1,
                    help="free-block floor for --admission watermark")
-    p.add_argument("--stream", action="store_true",
-                   help="emit a JSON line per token as it is generated")
+
+
+def add_obs_flags(p: argparse.ArgumentParser) -> None:
+    """Metrics/tracing/profiling flags, shared with the front end."""
     p.add_argument("--tb_dir", default=None,
                    help="TensorBoard dir for serving-load metrics")
     p.add_argument("--metrics_every", type=int, default=20,
@@ -102,29 +113,28 @@ def build_argparser() -> argparse.ArgumentParser:
                         "under --trace_dir (or --tb_dir)/xla_profile")
     p.add_argument("--device", default=None,
                    help="jax platform override (cpu|tpu)")
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    add_model_flags(p)
+    p.add_argument("--requests", required=True,
+                   help="JSONL request file, or '-' for stdin")
+    add_engine_flags(p)
+    p.add_argument("--stream", action="store_true",
+                   help="emit a JSON line per token as it is generated")
+    add_obs_flags(p)
     return p
 
 
-def main(argv: list[str] | None = None) -> None:
-    p = build_argparser()
-    args = p.parse_args(argv)
-    if (args.ckpt is None) == (not args.init_random):
-        p.error("exactly one of --ckpt / --init_random is required")
-    if args.device:
-        os.environ["JAX_PLATFORMS"] = args.device
-
-    import jax
-
-    from gpt_2_distributed_tpu.checkpoint import latest_checkpoint, restore_params
-    from gpt_2_distributed_tpu.config import MODEL_PRESETS, ServeConfig
-    from gpt_2_distributed_tpu.models import gpt2
+def setup_observability(p: argparse.ArgumentParser, args: argparse.Namespace):
+    """Tracing + XLA-capture wiring shared by serve and the front end.
+    Returns the armed :class:`XlaCapture` (inert when unconfigured)."""
     from gpt_2_distributed_tpu.obs.trace import (
         XlaCapture,
         configure_tracing,
-        get_tracer,
         parse_profile_at,
     )
-    from gpt_2_distributed_tpu.serving import ServingEngine
 
     if args.trace_dir:
         configure_tracing(args.trace_dir,
@@ -136,7 +146,17 @@ def main(argv: list[str] | None = None) -> None:
     profile_root = args.trace_dir or args.tb_dir
     if xla_profile_spec and not profile_root:
         p.error("--xla_profile_at needs --trace_dir or --tb_dir for output")
-    xla_capture = XlaCapture(xla_profile_spec, profile_root)
+    return XlaCapture(xla_profile_spec, profile_root)
+
+
+def load_model(args: argparse.Namespace):
+    """(config, params) from --model overrides + checkpoint/--init_random.
+    Call after the jax platform is pinned."""
+    import jax
+
+    from gpt_2_distributed_tpu.checkpoint import latest_checkpoint, restore_params
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS
+    from gpt_2_distributed_tpu.models import gpt2
 
     overrides = {
         k: getattr(args, k)
@@ -161,6 +181,61 @@ def main(argv: list[str] | None = None) -> None:
         shardings = jax.tree_util.tree_map(lambda _: one_device, template)
         params, meta = restore_params(path, template, shardings)
         print(f"checkpoint: {path} (step {meta.step})", file=sys.stderr)
+    return config, params
+
+
+def build_serve_config(args: argparse.Namespace, config):
+    """ServeConfig from the shared engine flags (0 blocks = worst case)."""
+    from gpt_2_distributed_tpu.config import ServeConfig
+
+    num_blocks = args.num_blocks
+    probe = ServeConfig(max_batch=args.max_batch, block_size=args.block_size)
+    if num_blocks == 0:
+        num_blocks = 1 + args.max_batch * probe.max_blocks_per_seq(
+            config.n_positions
+        )
+    return ServeConfig(
+        max_batch=args.max_batch, block_size=args.block_size,
+        num_blocks=num_blocks, attn_impl=args.attn_impl, eos_id=args.eos,
+        prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache,
+        admission=args.admission, watermark_blocks=args.watermark_blocks,
+    )
+
+
+def make_tracker(args: argparse.Namespace):
+    """The --tb_dir serving sink, or None."""
+    if not args.tb_dir:
+        return None
+    from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
+
+    # batch/seq 0: the serving sink never counts training tokens —
+    # every update is out-of-band (count_tokens=False), TB-only.
+    return StatsTracker(
+        args.tb_dir, batch_size=0, seq_len=0,
+        print_fn=lambda s: print(s, file=sys.stderr),
+    )
+
+
+DRAIN_NOTICE = ("draining: in-flight requests will complete, new submits "
+                "are refused, then exit 0")
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = build_argparser()
+    args = p.parse_args(argv)
+    if (args.ckpt is None) == (not args.init_random):
+        p.error("exactly one of --ckpt / --init_random is required")
+    if args.device:
+        os.environ["JAX_PLATFORMS"] = args.device
+
+    from gpt_2_distributed_tpu.obs.trace import get_tracer
+    from gpt_2_distributed_tpu.resilience import PreemptionHandler
+    from gpt_2_distributed_tpu.serving import ServingEngine
+    from gpt_2_distributed_tpu.serving.frontend.driver import EngineDriver
+    from gpt_2_distributed_tpu.serving.frontend.router import ReplicaRouter
+
+    xla_capture = setup_observability(p, args)
+    config, params = load_model(args)
 
     lines = (sys.stdin if args.requests == "-"
              else open(args.requests, encoding="utf-8"))
@@ -194,31 +269,21 @@ def main(argv: list[str] | None = None) -> None:
     if not specs:
         sys.exit("--requests: no requests")
 
-    num_blocks = args.num_blocks
-    probe = ServeConfig(max_batch=args.max_batch, block_size=args.block_size)
-    if num_blocks == 0:
-        num_blocks = 1 + args.max_batch * probe.max_blocks_per_seq(
-            config.n_positions
-        )
-    serve = ServeConfig(
-        max_batch=args.max_batch, block_size=args.block_size,
-        num_blocks=num_blocks, attn_impl=args.attn_impl, eos_id=args.eos,
-        prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache,
-        admission=args.admission, watermark_blocks=args.watermark_blocks,
+    serve = build_serve_config(args, config)
+    router = ReplicaRouter(
+        lambda: ServingEngine(params, config, serve,
+                              temperature=args.temperature, top_k=args.top_k),
+        replicas=1,
     )
-    eng = ServingEngine(params, config, serve,
-                        temperature=args.temperature, top_k=args.top_k)
-
-    tracker = None
-    if args.tb_dir:
-        from gpt_2_distributed_tpu.metrics.tracker import StatsTracker
-
-        # batch/seq 0: the serving sink never counts training tokens —
-        # every update is out-of-band (count_tokens=False), TB-only.
-        tracker = StatsTracker(
-            args.tb_dir, batch_size=0, seq_len=0,
-            print_fn=lambda s: print(s, file=sys.stderr),
-        )
+    tracker = make_tracker(args)
+    # SIGTERM = finish what was accepted, exit 0. Every request below is
+    # submitted before the loop starts, so the flag can only ever shorten
+    # the idle tail — it exists so a supervisor's TERM during a long batch
+    # drains instead of dropping streams mid-token.
+    handler = PreemptionHandler(notice=DRAIN_NOTICE).install()
+    driver = EngineDriver(router, tracker=tracker,
+                          metrics_every=args.metrics_every,
+                          xla_capture=xla_capture, preemption=handler)
 
     def on_token(req, tok):
         if args.stream:
@@ -230,29 +295,18 @@ def main(argv: list[str] | None = None) -> None:
         # ValueError here (prompt too long, new<1, ...) is a bad REQUEST:
         # report and fail loudly rather than serving the rest silently.
         try:
-            handles.append(eng.submit(ids, new, rng=seed, on_token=on_token))
+            handles.append(driver.submit(ids, new, rng=seed,
+                                         on_token=on_token))
         except ValueError as e:
             sys.exit(f"request {len(handles)}: {e}")
-    if tracker is None and xla_profile_spec is None:
-        eng.run_until_idle()
-    else:
-        steps = 0
-        while eng._queue or eng._has_active():
-            xla_capture.maybe_start(steps + 1)
-            eng.step()
-            steps += 1
-            xla_capture.maybe_stop(steps)
-            if tracker is not None and steps % max(args.metrics_every, 1) == 0:
-                tracker.update(steps, count_tokens=False,
-                               **eng.metrics_snapshot())
-        xla_capture.stop_if_active()
-        if tracker is not None:
-            tracker.update(steps + 1, count_tokens=False,
-                           **eng.metrics_snapshot())
-            tracker.close()
+    driver.drain()
+    if tracker is not None:
+        tracker.close()
     get_tracer().close()
+    handler.uninstall()
     wall = time.monotonic() - t0
 
+    eng = router.engines[0]
     for h in handles:
         print(json.dumps({
             "id": h.id,

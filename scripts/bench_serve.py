@@ -70,16 +70,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-from gpt_2_distributed_tpu.obs.trace import (  # noqa: E402 — needs REPO path
-    XlaCapture,
-    configure_tracing,
-    get_tracer,
-    parse_profile_at,
-)
-
-# Inert until main() arms it from --xla_profile_at; one capture window per
-# bench process (the first replay that reaches the armed step wins).
-_XLA_CAPTURE = XlaCapture(None, None)
+# Armed by main() from --xla_profile_at (one capture window per bench
+# process; the first replay that reaches the armed step wins). None until
+# then: obs.trace must NOT be imported at module scope — the package
+# __init__ pulls in jax, and the CLI contract (tested with a poisoned jax
+# on PYTHONPATH) is that --help and flag validation never touch jax.
+_XLA_CAPTURE = None
 
 
 def build_argparser() -> argparse.ArgumentParser:
@@ -145,8 +141,36 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="skip the features-off engine replay")
     p.add_argument("--baseline_only", action="store_true",
                    help="run only the one-shot comparison (engine debug)")
+    # Front-door mode (scripts/bench_serve.py --duration): open-loop load
+    # against the replica router + autoscaler instead of the closed traces.
+    p.add_argument("--duration", type=float, default=0.0,
+                   help="front-door mode: offer Poisson arrivals for this "
+                   "many seconds against the replica router (open loop — "
+                   "arrivals never wait for completions), then drain. 0 "
+                   "keeps the classic closed-trace bench")
+    p.add_argument("--ramp", type=float, default=None,
+                   help="ramp the arrival rate linearly from --rate to this "
+                   "over --duration (the autoscaler probe); default holds "
+                   "--rate constant")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="front-door mode: engine replicas to start with")
+    p.add_argument("--max_replicas", type=int, default=None,
+                   help="front-door mode: fleet ceiling; > --replicas "
+                   "attaches the autoscaler (closed loop: queue depth and "
+                   "SLO pressure grow the fleet, idle shrinks it)")
+    p.add_argument("--route", default="affinity",
+                   choices=["affinity", "least_loaded", "round_robin"],
+                   help="front-door mode: routing policy for the measured "
+                   "run (a round_robin control runs either way)")
+    p.add_argument("--ttft_slo_ms", type=float, default=None,
+                   help="front-door mode: TTFT target; violations counted "
+                   "and fed to the autoscaler")
+    p.add_argument("--queue_slo_ms", type=float, default=None,
+                   help="front-door mode: shed arrivals whose predicted "
+                   "queue wait exceeds this")
     p.add_argument("--json", default="BENCH_SERVE.json", metavar="PATH",
-                   help="result file ('' disables the write)")
+                   help="result file ('' disables the write); front-door "
+                   "mode merges a 'frontend' record into an existing file")
     p.add_argument("--trace_dir", default=None,
                    help="write span/event trace JSONL here (obs/trace.py)")
     p.add_argument("--xla_profile_at", default=None, metavar="STEP[:NSTEPS]",
@@ -185,6 +209,22 @@ def validate_args(p: argparse.ArgumentParser, args: argparse.Namespace) -> None:
         p.error(f"--watermark_blocks {args.watermark_blocks}: must be >= 0")
     if args.repeats < 1:
         p.error(f"--repeats {args.repeats}: need at least one measurement")
+    if args.duration < 0:
+        p.error(f"--duration {args.duration}: must be >= 0")
+    if args.ramp is not None:
+        if args.duration <= 0:
+            p.error("--ramp only makes sense with --duration")
+        if args.ramp <= 0:
+            p.error(f"--ramp {args.ramp}: target rate must be positive")
+    if args.duration > 0:
+        if args.baseline_only or args.no_pr7 or args.no_baseline:
+            p.error("--duration (front-door mode) does not run the "
+                    "closed-trace comparisons; drop the baseline flags")
+        if args.replicas < 1:
+            p.error(f"--replicas {args.replicas}: must be >= 1")
+        if args.max_replicas is not None and args.max_replicas < args.replicas:
+            p.error(f"--max_replicas {args.max_replicas} < --replicas "
+                    f"{args.replicas}")
     if args.xla_profile_at is not None:
         from gpt_2_distributed_tpu.obs.trace import parse_profile_at
 
@@ -316,10 +356,12 @@ def run_engine(args, params, config, serve, trace, jax, np, make_engine):
                     on_token=on_token,
                 ))
                 nxt += 1
-            _XLA_CAPTURE.maybe_start(step_no + 1)
+            if _XLA_CAPTURE is not None:
+                _XLA_CAPTURE.maybe_start(step_no + 1)
             stepped = eng.step()
             step_no += 1
-            _XLA_CAPTURE.maybe_stop(step_no)
+            if _XLA_CAPTURE is not None:
+                _XLA_CAPTURE.maybe_stop(step_no)
             if (stepped == 0 and not eng._has_active() and not eng._queue
                     and nxt < n):
                 # Truly idle: nothing in flight, nothing queued — wait for
@@ -377,6 +419,129 @@ def run_engine(args, params, config, serve, trace, jax, np, make_engine):
     return best
 
 
+def run_frontend(args, config, serve, jax, np, make_engine, policy):
+    """Open-loop Poisson load for --duration seconds against the replica
+    router (optionally autoscaled), then drain; returns the record.
+
+    Open loop means arrivals are generated by the clock, never gated on
+    completions — the regime where queues actually build. The rate ramps
+    linearly from --rate to --ramp across the window. ~--shared_prefix_frac
+    of prompts open with a common prefix so prefix-affinity routing has
+    structure to exploit; compiles triggered by autoscaler growth happen
+    in-run, exactly as they would in production lazy growth.
+    """
+    from gpt_2_distributed_tpu.serving.frontend.autoscale import Autoscaler
+    from gpt_2_distributed_tpu.serving.frontend.driver import EngineDriver
+    from gpt_2_distributed_tpu.serving.frontend.router import (
+        ReplicaRouter,
+        ShedError,
+    )
+
+    max_replicas = args.max_replicas or args.replicas
+    router = ReplicaRouter(
+        lambda: make_engine(serve), replicas=args.replicas,
+        max_replicas=max_replicas, policy=policy,
+        ttft_slo_ms=args.ttft_slo_ms, queue_slo_ms=args.queue_slo_ms,
+        # distinct rid namespaces per policy: the measured run and the
+        # round_robin control share one --trace_dir
+        rid_start={"affinity": 0, "least_loaded": 1_000_000,
+                   "round_robin": 2_000_000}[policy],
+    )
+    scaler = (Autoscaler(router, min_replicas=args.replicas,
+                         max_replicas=max_replicas)
+              if max_replicas > args.replicas else None)
+    driver = EngineDriver(router, autoscaler=scaler, autoscale_every=8)
+
+    # Warm the initial replicas' prompt-length buckets directly (bypassing
+    # the router so its counters stay clean), then reset engine stats.
+    bs = serve.block_size
+    cap = config.n_positions - 2
+    longest = max(args.prompt_max, args.shared_prefix_len + 1)
+    buckets = ({-(-longest // bs)} if serve.prefill_chunk else
+               set(range(-(-args.prompt_min // bs), -(-longest // bs) + 1)))
+    for eng in router.engines:
+        for nb in sorted(buckets):
+            eng.submit([3 + nb] * min(nb * bs, cap), 2, rng=0)
+        eng.run_until_idle()
+        eng.clear_prefix_cache()
+        eng.stats = {k: type(v)() for k, v in eng.stats.items()}
+
+    rng = np.random.default_rng(args.trace_seed)
+    pfx = rng.integers(0, config.vocab_size, args.shared_prefix_len).tolist()
+
+    def draw_prompt():
+        pl = int(rng.integers(args.prompt_min, args.prompt_max + 1))
+        if rng.random() < args.shared_prefix_frac:
+            pl = max(pl, args.shared_prefix_len + 1)
+            return pfx + rng.integers(
+                0, config.vocab_size, pl - args.shared_prefix_len
+            ).tolist()
+        return rng.integers(0, config.vocab_size, pl).tolist()
+
+    r0 = args.rate
+    r1 = args.ramp if args.ramp is not None else args.rate
+    dur = args.duration
+    arrivals: dict[int, float] = {}     # rid -> offered wall time
+    handles = []
+    offered = sheds = 0
+    max_active = router.n_active
+    t0 = time.monotonic()
+    t_next = float(rng.exponential(1.0 / r0))
+    while True:
+        now = time.monotonic() - t0
+        while t_next <= now and t_next < dur:
+            prompt = draw_prompt()
+            new = int(rng.integers(args.new_min, args.new_max + 1))
+            offered += 1
+            try:
+                h = driver.submit(
+                    prompt, new,
+                    rng=jax.random.PRNGKey(args.trace_seed * 100_000
+                                           + offered),
+                )
+                arrivals[h.id] = t0 + t_next
+                handles.append(h)
+            except ShedError:
+                sheds += 1
+            rate = r0 + (r1 - r0) * min(t_next / dur, 1.0)
+            t_next += float(rng.exponential(1.0 / rate))
+        if driver.has_work():
+            driver.step()
+            max_active = max(max_active, router.n_active)
+        elif t_next < dur:
+            time.sleep(min(0.001, max(0.0, t_next - now)))
+        else:
+            break
+    wall = time.monotonic() - t0
+
+    assert all(h.done for h in handles)
+    emitted = sum(len(h.generated) for h in handles)
+    ttfts = [h.first_token_time - arrivals[h.id] for h in handles]
+    ttft_p50, ttft_p99 = percentiles(ttfts, np)
+    per_replica = [len([h for h in handles if h.replica == i])
+                   for i in range(len(router.engines))]
+    rec = {
+        "policy": policy,
+        "wall_s": round(wall, 4),
+        "offered": offered,
+        "completed": len(handles),
+        "shed": sheds,
+        "shed_rate": round(sheds / max(offered, 1), 4),
+        "tok_s": round(emitted / wall, 1),
+        "ttft_p50_ms": ttft_p50, "ttft_p99_ms": ttft_p99,
+        "slo_violations": router.slo_violations,
+        "prefix_cache_hit_rate": round(router.aggregate_hit_rate(), 4),
+        "affinity_hits": router.affinity_hits,
+        "requests_per_replica": per_replica,
+        "replicas_final": router.n_active,
+        "replicas_max": max_active,
+    }
+    if scaler is not None:
+        rec["scale_ups"] = scaler.scale_ups
+        rec["scale_downs"] = scaler.scale_downs
+    return rec
+
+
 def main(argv=None) -> None:
     p = build_argparser()
     args = p.parse_args(argv)
@@ -389,6 +554,12 @@ def main(argv=None) -> None:
     from gpt_2_distributed_tpu.config import MODEL_PRESETS, ServeConfig
     from gpt_2_distributed_tpu.models import gpt2
     from gpt_2_distributed_tpu.models.decode import generate_cached
+    from gpt_2_distributed_tpu.obs.trace import (
+        XlaCapture,
+        configure_tracing,
+        get_tracer,
+        parse_profile_at,
+    )
     from gpt_2_distributed_tpu.serving import ServingEngine
 
     global _XLA_CAPTURE
@@ -438,6 +609,51 @@ def main(argv=None) -> None:
     def make_engine(serve):
         return ServingEngine(params, config, serve,
                              temperature=args.temperature, top_k=args.top_k)
+
+    if args.duration > 0:
+        # Front-door mode: measured run under --route, plus a round_robin
+        # control on the same seed — the affinity-vs-spray comparison the
+        # router exists for. Merges into an existing --json file so the
+        # closed-trace records survive.
+        serve_new, _ = serve_pair(args.num_blocks)
+        rec = {
+            "duration_s": args.duration,
+            "rate_req_s": [args.rate,
+                           args.ramp if args.ramp is not None else args.rate],
+            "replicas": args.replicas,
+            "max_replicas": args.max_replicas or args.replicas,
+            "ttft_slo_ms": args.ttft_slo_ms,
+            "queue_slo_ms": args.queue_slo_ms,
+            "shared_prefix_frac": args.shared_prefix_frac,
+            "shared_prefix_len": args.shared_prefix_len,
+            "serve": {"max_batch": serve_new.max_batch,
+                      "block_size": serve_new.block_size,
+                      "num_blocks": serve_new.num_blocks,
+                      "prefix_cache": serve_new.prefix_cache,
+                      "admission": serve_new.admission},
+            args.route: run_frontend(args, config, serve_new, jax, np,
+                                     make_engine, args.route),
+        }
+        if args.route != "round_robin":
+            rec["round_robin_control"] = run_frontend(
+                args, config, serve_new, jax, np, make_engine, "round_robin"
+            )
+        _XLA_CAPTURE.stop_if_active()
+        get_tracer().close()
+        if args.json:
+            out = {"bench": "serve",
+                   "device": jax.devices()[0].device_kind,
+                   "n_devices": jax.device_count(),
+                   "model": {"preset": args.model, **overrides}}
+            if os.path.exists(args.json):
+                with open(args.json) as f:
+                    out = json.load(f)
+            out["frontend"] = rec
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps({"frontend": rec}))
+        return
 
     result = {
         "bench": "serve",

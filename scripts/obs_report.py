@@ -146,7 +146,12 @@ def step_breakdown(
 
 
 # Lifecycle events that mark a request's trajectory, in waterfall order.
+# route/shed come from the replica router (serving/frontend/router.py) —
+# route precedes submit (the router picks a replica, then enqueues), and a
+# shed request has a route event but no submit at all.
 _REQUEST_EVENTS = (
+    "route",
+    "shed",
     "submit",
     "admit",
     "prefix_hit",
@@ -185,13 +190,20 @@ def request_waterfall(records: list[dict[str, Any]]) -> dict[str, Any] | None:
                     row[f"{name}_ms"] = (first_ts[name] - t_submit) * 1e3
             if "first_token" in first_ts:
                 ttfts.append(first_ts["first_token"] - t_submit)
-        # Cached/chunked prefill details when the engine attached them.
+        # Cached/chunked prefill details when the engine attached them,
+        # plus the router's placement decision when a front end was in play.
         for e in evs:
             a = e.get("attrs", {})
             if e["name"] == "prefix_hit" and "tokens" in a:
                 row["prefix_cached_tokens"] = a["tokens"]
             if e["name"] == "finish" and "n_generated" in a:
                 row["n_generated"] = a["n_generated"]
+            if e["name"] == "route":
+                row["replica"] = a.get("replica")
+                row["route_policy"] = a.get("policy")
+                row["affinity_blocks"] = a.get("affinity_blocks")
+            if e["name"] == "shed":
+                row["shed"] = True
         requests.append(row)
 
     return {
@@ -201,14 +213,43 @@ def request_waterfall(records: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def frontend_summary(serving: dict[str, Any] | None) -> dict[str, Any] | None:
+    """Fleet view over routed requests: placement spread, policy mix,
+    sheds. None when no router events are in the trace."""
+    if not serving:
+        return None
+    routed = [r for r in serving["requests"] if "replica" in r]
+    if not routed:
+        return None
+    sheds = [r for r in serving["requests"] if r.get("shed")]
+    per_replica: dict[str, int] = defaultdict(int)
+    per_policy: dict[str, int] = defaultdict(int)
+    for r in routed:
+        if not r.get("shed"):
+            per_replica[str(r["replica"])] += 1
+        per_policy[str(r.get("route_policy"))] += 1
+    return {
+        "n_routed": len(routed),
+        "n_shed": len(sheds),
+        "requests_per_replica": dict(sorted(per_replica.items())),
+        "routes_by_policy": dict(sorted(per_policy.items())),
+        "affinity_share": round(
+            (per_policy.get("affinity", 0) + per_policy.get("sticky", 0))
+            / len(routed), 4
+        ),
+    }
+
+
 def build_report(trace_dir: str) -> dict[str, Any]:
     records = load_trace_dir(trace_dir)
+    serving = request_waterfall(records)
     return {
         "trace_dir": trace_dir,
         "n_records": len(records),
         "train_steps": step_breakdown(records, "step"),
         "engine_steps": step_breakdown(records, "engine_step"),
-        "serving": request_waterfall(records),
+        "serving": serving,
+        "frontend": frontend_summary(serving),
     }
 
 
@@ -252,12 +293,47 @@ def _print_serving(s: dict[str, Any], limit: int) -> None:
         print(f"  ... {len(s['requests']) - limit} more (raise --limit)")
 
 
+def _print_frontend(report: dict[str, Any], limit: int) -> None:
+    """Per-request routed waterfall: queue -> route -> admit -> first
+    token, with the router's placement decision on every row."""
+    fs = report["frontend"]
+    s = report["serving"]
+    print(f"\n== front end: {fs['n_routed']} routed, {fs['n_shed']} shed ==")
+    print(f"  requests/replica: {fs['requests_per_replica']}  "
+          f"routes by policy: {fs['routes_by_policy']}  "
+          f"affinity share: {fs['affinity_share']:.0%}")
+    print(f"  {'rid':<8} {'replica':>7} {'policy':<12} {'aff_blk':>7} "
+          f"{'queue_ms':>9} {'ttft_ms':>9} {'finish_ms':>10}")
+    shown = 0
+    for row in s["requests"]:
+        if "replica" not in row or shown >= limit:
+            continue
+        shown += 1
+        if row.get("shed"):
+            print(f"  {str(row['rid']):<8} {row['replica']:>7} "
+                  f"{str(row.get('route_policy')):<12} "
+                  f"{row.get('affinity_blocks', 0):>7} "
+                  f"{'— shed (503)':>31}")
+            continue
+        print(
+            f"  {str(row['rid']):<8} {row['replica']:>7} "
+            f"{str(row.get('route_policy')):<12} "
+            f"{row.get('affinity_blocks', 0):>7} "
+            f"{row.get('admit_ms', float('nan')):>9.2f} "
+            f"{row.get('first_token_ms', float('nan')):>9.2f} "
+            f"{row.get('finish_ms', float('nan')):>10.2f}"
+        )
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("trace_dir", help="directory holding trace-p*.jsonl files")
     ap.add_argument("--json", action="store_true", help="emit one JSON object")
     ap.add_argument("--limit", type=int, default=40,
                     help="max per-request rows to print (text mode)")
+    ap.add_argument("--frontend", action="store_true",
+                    help="per-request routed waterfall (queue -> route -> "
+                         "admit -> first_token) with replica placement")
     args = ap.parse_args(argv)
 
     try:
@@ -277,6 +353,13 @@ def main(argv: list[str] | None = None) -> int:
         _print_breakdown(report["engine_steps"], "serving engine-step breakdown")
     if report["serving"]:
         _print_serving(report["serving"], args.limit)
+    if args.frontend:
+        if report["frontend"]:
+            _print_frontend(report, args.limit)
+        else:
+            print("no route/shed events in this trace — was the request "
+                  "routed through the front end (gpt2-tpu-frontend or "
+                  "bench_serve --duration) with --trace_dir?")
     if not any((report["train_steps"], report["engine_steps"], report["serving"])):
         print("no step spans or request events found — was tracing enabled?")
     return 0

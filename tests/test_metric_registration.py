@@ -94,19 +94,28 @@ def _splat_keys_from_scope(scope: ast.AST, varname: str) -> set[str]:
 
 
 def _metrics_snapshot_keys() -> set[str]:
-    """Keys of ``ServingEngine.metrics_snapshot``'s returned dict literal —
-    what ``**eng.metrics_snapshot()`` splats push."""
-    path = os.path.join(PKG, "serving", "engine.py")
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), path)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == "metrics_snapshot":
-            for ret in ast.walk(node):
-                if isinstance(ret, ast.Return) and isinstance(
-                    ret.value, ast.Dict
-                ):
-                    return _dict_literal_keys(ret.value)
-    raise AssertionError("metrics_snapshot return dict literal not found")
+    """Union of every ``metrics_snapshot``'s returned dict-literal keys —
+    what a ``**x.metrics_snapshot()`` splat can push. Both the engine's
+    (single replica) and the router's (fleet aggregate) snapshots feed
+    the same update site in serving/frontend/driver.py."""
+    keys: set[str] = set()
+    for rel in (("serving", "engine.py"),
+                ("serving", "frontend", "router.py")):
+        path = os.path.join(PKG, *rel)
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), path)
+        found = False
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name == "metrics_snapshot"):
+                for ret in ast.walk(node):
+                    if isinstance(ret, ast.Return) and isinstance(
+                        ret.value, ast.Dict
+                    ):
+                        keys |= _dict_literal_keys(ret.value)
+                        found = True
+        assert found, f"metrics_snapshot return dict literal not in {path}"
+    return keys
 
 
 def collect_pushed_names():
@@ -163,7 +172,8 @@ def test_update_call_sites_found():
     registration check below would vacuously pass."""
     pushed = collect_pushed_names()
     files = {os.path.basename(p) for p, _, _ in pushed}
-    assert "train.py" in files and "serve.py" in files
+    # serving pushes now flow through the shared driver, not serve.py
+    assert "train.py" in files and "driver.py" in files
     names = {n for _, _, n in pushed}
     # spot-check resolution of each pattern: direct kwarg, dict(...) call,
     # subscript assign, and the metrics_snapshot splat
@@ -172,7 +182,8 @@ def test_update_call_sites_found():
     assert "skipped_steps" in names    # extra = {...} literal
     assert "save_failures" in names    # extra["save_failures"] = ...
     assert "fused_fallback" in names   # the bug this test exists to catch
-    assert "queue_wait_ms" in names    # **eng.metrics_snapshot()
+    assert "queue_wait_ms" in names    # **router.metrics_snapshot()
+    assert "route_affinity_hits" in names  # fleet-level router key
 
 
 def test_every_pushed_metric_is_registered():
