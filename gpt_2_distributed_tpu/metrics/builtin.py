@@ -252,6 +252,10 @@ for _name, _dist in (
     ("serve_shed", "sum"),             # cumulative SLO-admission refusals (503s)
     ("route_affinity_hits", "sum"),    # cumulative prefix-affinity route decisions
     ("slo_violations", "sum"),         # cumulative finished requests over TTFT SLO
+    ("replica_failures", "sum"),       # cumulative replicas marked FAILED
+    ("requests_migrated", "sum"),      # cumulative requests moved off failed replicas
+    ("requests_timed_out", "sum"),     # cumulative deadline evictions (504s)
+    ("watchdog_trips", "sum"),         # cumulative step-watchdog firings
 ):
     METRIC_REGISTRY.metric(
         _name, reduction=ReductionStrategy.CURRENT, tb_prefix="serve/",

@@ -385,6 +385,101 @@ class PreemptionHandler:
         return self._flag
 
 
+class InjectedFault(RuntimeError):
+    """Raised by :class:`FaultInjector` at its configured trigger point."""
+
+
+def parse_fault_spec(spec: str, flag: str) -> tuple[int, int | None]:
+    """Parse ``"STEP"`` or ``"STEP:REPLICA"`` (the ``--inject_*`` flag
+    grammar, mirroring ``--xla_profile_at``'s ``STEP[:N]``). Returns
+    ``(step, replica)`` with ``replica=None`` meaning "first replica
+    stepped at/after STEP". Import-light on purpose: ``bench_serve``
+    validates these flags before jax loads."""
+    parts = str(spec).split(":")
+    if len(parts) > 2:
+        raise ValueError(f"{flag}={spec!r}: expected STEP or STEP:REPLICA")
+    try:
+        step = int(parts[0])
+        replica = int(parts[1]) if len(parts) == 2 else None
+    except ValueError:
+        raise ValueError(
+            f"{flag}={spec!r}: STEP and REPLICA must be integers"
+        ) from None
+    if step < 1:
+        raise ValueError(f"{flag}={spec!r}: STEP must be >= 1")
+    if replica is not None and replica < 0:
+        raise ValueError(f"{flag}={spec!r}: REPLICA must be >= 0")
+    return step, replica
+
+
+class FaultInjector:
+    """Deterministic fault injection for the serving fleet (tests and the
+    chaos bench — never constructed in production).
+
+    The driver calls :meth:`tick(step, replica)` immediately before each
+    replica's ``step()``, inside the containment wrapper and the watchdog
+    window. Each configured fault fires ONCE, at the first tick whose
+    fleet step is >= the spec's STEP and whose replica matches (``>=``,
+    not ``==``: a replica with no work that tick would otherwise dodge
+    the fault forever):
+
+    * ``fail_at``  — raise :class:`InjectedFault`: the "replica crashed"
+      scenario (containment + migration).
+    * ``hang_at``  — block cooperatively until :meth:`release_hangs`
+      (the watchdog's trip path calls it) or ``hang_max_s``, then raise:
+      the "replica wedged" scenario. A real hang can't be interrupted
+      from within; the cooperative version lets tests drive the whole
+      detect -> condemn -> migrate chain deterministically.
+    * ``exception_at`` — replica-agnostic raise: the original
+      fleet-killer at driver.py's step loop, now contained.
+    """
+
+    def __init__(
+        self,
+        fail_at: tuple[int, int | None] | None = None,
+        hang_at: tuple[int, int | None] | None = None,
+        exception_at: int | None = None,
+        hang_max_s: float = 120.0,
+    ) -> None:
+        self.fail_at = fail_at
+        self.hang_at = hang_at
+        self.exception_at = exception_at
+        self.hang_max_s = float(hang_max_s)
+        self.fail_fired = False
+        self.hang_fired = False
+        self.exception_fired = False
+        self._release = threading.Event()
+
+    @staticmethod
+    def _match(spec, step: int, replica: int) -> bool:
+        return step >= spec[0] and (spec[1] is None or replica == spec[1])
+
+    def release_hangs(self) -> None:
+        """Unblock any in-progress (and all future) injected hangs."""
+        self._release.set()
+
+    def tick(self, step: int, replica: int) -> None:
+        if (self.fail_at is not None and not self.fail_fired
+                and self._match(self.fail_at, step, replica)):
+            self.fail_fired = True
+            raise InjectedFault(
+                f"injected replica failure (step {step}, replica {replica})"
+            )
+        if (self.exception_at is not None and not self.exception_fired
+                and step >= self.exception_at):
+            self.exception_fired = True
+            raise InjectedFault(f"injected step exception (step {step})")
+        if (self.hang_at is not None and not self.hang_fired
+                and self._match(self.hang_at, step, replica)):
+            self.hang_fired = True
+            released = self._release.wait(self.hang_max_s)
+            raise InjectedFault(
+                f"injected replica hang (step {step}, replica {replica}) "
+                + ("released by watchdog" if released
+                   else f"expired after {self.hang_max_s:g}s")
+            )
+
+
 # GCE metadata server's preemption endpoint: returns "TRUE" once the VM has
 # been marked for preemption. Requires the Metadata-Flavor header; only
 # reachable from inside a GCE/TPU VM (tests inject a file:// URL instead).

@@ -117,7 +117,10 @@ class RequestHandle:
         self.on_token = on_token
         self.generated: list[int] = []
         self.done = False
-        self.finish_reason: str | None = None  # "eos" | "length"
+        # "eos" | "length" | "timeout" (deadline exceeded) | "failed"
+        # (replica lost with no healthy replica to migrate to)
+        self.finish_reason: str | None = None
+        self.deadline: float | None = None   # monotonic; None = no deadline
         self.submit_time: float | None = None
         self.first_token_time: float | None = None
         self.finish_time: float | None = None
@@ -420,10 +423,11 @@ class ServingEngine:
         self._queue: collections.deque[RequestHandle] = collections.deque()
         self._next_id = 0
         self._admit_seq = 0
+        self._deadlines = False   # any live request carries a deadline
         self.stats = {
             "admitted": 0, "finished": 0, "prefills": 0, "prefill_chunks": 0,
             "decode_steps": 0, "tokens_out": 0,
-            "preemptions": 0, "resumes": 0,
+            "preemptions": 0, "resumes": 0, "timeouts": 0,
             "prefix_hit_tokens": 0, "cow_copies": 0,
             "prefill_ms": 0.0, "decode_ms": 0.0, "queue_wait_ms": 0.0,
         }
@@ -473,6 +477,7 @@ class ServingEngine:
         rng: jax.Array | int = 0,
         on_token: Callable[[RequestHandle, int], None] | None = None,
         rid: int | None = None,
+        timeout_s: float | None = None,
     ) -> RequestHandle:
         """Queue a request. Validation happens HERE (the admission gate),
         with the same ``check_generation_args`` ValueErrors as both decode
@@ -482,6 +487,11 @@ class ServingEngine:
         assigns FLEET-unique ids so trace events and API response ids from
         different replicas can never collide. Single-engine callers leave
         it None and get the engine counter (0, 1, 2, ... in submit order).
+
+        ``timeout_s`` sets a wall-clock deadline counted from submission
+        (queue wait included). An overdue request is evicted at the next
+        step boundary with finish reason ``"timeout"`` and its blocks
+        freed — generated-so-far tokens stay on the handle.
         """
         prompt = [int(t) for t in prompt]
         check_generation_args(
@@ -500,9 +510,14 @@ class ServingEngine:
         if rid is None:
             rid = self._next_id
             self._next_id += 1
+        if timeout_s is not None and timeout_s < 0:
+            raise ValueError(f"timeout_s must be >= 0, got {timeout_s}")
         req = RequestHandle(rid, prompt, max_new_tokens, on_token)
         req._key = np.asarray(rng, np.uint32)
         req.submit_time = time.monotonic()
+        if timeout_s is not None:
+            req.deadline = req.submit_time + timeout_s
+            self._deadlines = True
         req._enqueue_time = req.submit_time
         self._queue.append(req)
         get_tracer().event(
@@ -821,6 +836,82 @@ class ServingEngine:
         )
         self._queue.appendleft(req)
 
+    def _evict_overdue(self) -> int:
+        """Evict every request past its deadline — slotted rows via
+        ``_evict`` (blocks freed, slot reopened), queued requests by
+        removal. Runs at step boundaries only when some live request
+        actually carries a deadline, so deadline-free deployments pay
+        nothing."""
+        if not self._deadlines:
+            return 0
+        now = time.monotonic()
+        evicted = 0
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.deadline is not None \
+                    and now >= req.deadline:
+                self._evict(slot, "timeout")
+                self.stats["timeouts"] += 1
+                evicted += 1
+        overdue = [r for r in self._queue
+                   if r.deadline is not None and now >= r.deadline]
+        for req in overdue:
+            self._queue.remove(req)
+            req._finish("timeout")
+            self.stats["finished"] += 1
+            self.stats["timeouts"] += 1
+            evicted += 1
+        self._deadlines = any(
+            r is not None and r.deadline is not None
+            for r in list(self._slots) + list(self._queue)
+        )
+        return evicted
+
+    # ---------------------------------------------------------- migration
+
+    def extract_inflight(self) -> list[RequestHandle]:
+        """Detach every live request from this engine for migration to
+        another replica, in admission order (slotted rows first, then the
+        queue). Captures exactly the preemption state ``_preempt`` saves —
+        generated tokens plus the per-slot PRNG chain head — so a healthy
+        engine's ``adopt`` resumes each stream bit-identically with zero
+        re-emitted tokens. Block release is best-effort: the engine is
+        presumed failed and its pools are abandoned with it."""
+        out = []
+        slotted = sorted(
+            (s for s in range(self.serve.max_batch)
+             if self._slots[s] is not None),
+            key=lambda s: self._slots[s]._admit_order,
+        )
+        for slot in slotted:
+            req = self._slots[slot]
+            if req._prefill_pos is None:
+                # Decoding: the slot key is the live chain head (mid-
+                # prefill requests never advanced theirs — req._key
+                # already holds it). Same capture as _preempt.
+                req._key = np.array(self.keys[slot])
+            req._pending_token = req.generated[-1] if req.generated else None
+            try:
+                self._release_slot(slot)
+            except Exception:
+                # A failed engine's allocator may be inconsistent; the
+                # request state above is host-side and already safe.
+                self._slots[slot] = None
+            out.append(req)
+        out.extend(self._queue)
+        self._queue.clear()
+        return out
+
+    def adopt(self, req: RequestHandle) -> None:
+        """Enqueue a request extracted from another replica. No
+        validation — it already passed ``submit``'s gates on an engine
+        with an identical ``ServeConfig`` — and no new trace event id:
+        the handle (and its rid, callbacks, emitted tokens) carries over
+        whole."""
+        req._enqueue_time = time.monotonic()
+        if req.deadline is not None:
+            self._deadlines = True
+        self._queue.append(req)
+
     def _grow_tables(self) -> None:
         """Watermark mode, before each decode step: every active row about
         to write into an unallocated block gets one. On pool exhaustion,
@@ -889,6 +980,7 @@ class ServingEngine:
             return self._step_impl(tracer)
 
     def _step_impl(self, tracer) -> int:
+        self._evict_overdue()
         with tracer.span("admit"):
             self._try_admit()
         with tracer.span("prefill"):

@@ -13,6 +13,7 @@ from gpt_2_distributed_tpu.serving.frontend.autoscale import Autoscaler
 from gpt_2_distributed_tpu.serving.frontend.driver import (
     DrainingError,
     EngineDriver,
+    StepWatchdog,
 )
 from gpt_2_distributed_tpu.serving.frontend.router import (
     ROUTE_POLICIES,
@@ -27,4 +28,5 @@ __all__ = [
     "ROUTE_POLICIES",
     "ReplicaRouter",
     "ShedError",
+    "StepWatchdog",
 ]

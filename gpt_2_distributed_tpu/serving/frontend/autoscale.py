@@ -23,6 +23,12 @@ pattern applied to serving replicas:
   empty queue drags the mean down), so reacting to the pre-action reading
   would oscillate — the elastic trainer's restart-backoff serves the same
   purpose.
+* **Replacement** — a replica FAILURE (the router's ``replica_failures``
+  counter moved) is not ordinary pressure: capacity the deployment asked
+  for is gone. While ``n_active`` sits below ``min_replicas`` the
+  autoscaler grows immediately — no streak, no cooldown — unparking a
+  retiree or building a fresh replica. Above the floor, new failures
+  count as grow pressure and go through the normal hysteresis.
 
 The autoscaler only *decides*; the router owns the mechanism (activate a
 parked replica, retire the least-loaded). A retired replica keeps draining
@@ -77,20 +83,26 @@ class Autoscaler:
         self._cooldown_left = 0
         self._seen_sheds = router.shed_count
         self._seen_violations = router.slo_violations
+        # getattr: unit-test FakeRouters predate the failure surface.
+        self._seen_failures = getattr(router, "replica_failures", 0)
         self.scale_ups = 0
         self.scale_downs = 0
+        self.replacements = 0
         self.ticks = 0
 
     def _pressure(self) -> bool:
         new_sheds = self.router.shed_count - self._seen_sheds
         new_viol = self.router.slo_violations - self._seen_violations
+        fails = getattr(self.router, "replica_failures", 0)
+        new_fails = fails - self._seen_failures
         self._seen_sheds = self.router.shed_count
         self._seen_violations = self.router.slo_violations
+        self._seen_failures = fails
         depth_per_replica = (
             self.router.total_queue_depth() / max(self.router.n_active, 1)
         )
         return (depth_per_replica >= self.grow_queue_depth
-                or new_sheds > 0 or new_viol > 0)
+                or new_sheds > 0 or new_viol > 0 or new_fails > 0)
 
     def _idle(self) -> bool:
         if self.router.total_queue_depth() > 0:
@@ -111,6 +123,19 @@ class Autoscaler:
         self.ticks += 1
         pressure = self._pressure()
         idle = self._idle()
+        if self.router.n_active < self.min_replicas:
+            # Failure dropped the fleet below its floor: replace NOW,
+            # bypassing streaks and cooldown — waiting out hysteresis to
+            # restore promised capacity only prolongs the degradation.
+            if self.router.grow() is not None:
+                self.scale_ups += 1
+                self.replacements += 1
+                self._cooldown_left = self.cooldown
+                get_tracer().event(
+                    "autoscale", action="replace",
+                    replicas=self.router.n_active,
+                )
+                return "replace"
         if self._cooldown_left > 0:
             self._cooldown_left -= 1
             return None

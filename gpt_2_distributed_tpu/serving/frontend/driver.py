@@ -35,15 +35,117 @@ from __future__ import annotations
 
 import collections
 import concurrent.futures
+import sys
 import threading
+import time
 from typing import Callable, Sequence
 
+from gpt_2_distributed_tpu.obs.trace import get_tracer
 from gpt_2_distributed_tpu.serving.engine import RequestHandle
-from gpt_2_distributed_tpu.serving.frontend.router import ReplicaRouter
+from gpt_2_distributed_tpu.serving.frontend.router import (
+    ReplicaRouter,
+    ShedError,
+)
 
 
 class DrainingError(RuntimeError):
     """Submit refused: the driver is draining toward shutdown."""
+
+
+class StepWatchdog:
+    """Daemon thread bounding how long one replica's ``step()`` may run.
+
+    The ``coordination.HangWatchdog`` idiom (arm/beat/disarm around the
+    guarded region, a lock-protected deadline, a check interval of
+    ``min(timeout/4, 0.5)``) with one deliberate difference: firing does
+    NOT kill the process. Serving a fleet, a wedged replica costs one
+    replica — the watchdog dumps all-thread stacks plus the tracer's open
+    spans (the "which phase hung" post-mortem), then hands the replica
+    index to ``on_trip``, which condemns it so the driver fails and
+    migrates it the moment (if ever) the stuck call returns. One trip per
+    arm: after firing the watchdog disarms itself and keeps watching the
+    NEXT armed step.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_trip: Callable[[int], None],
+    ) -> None:
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.on_trip = on_trip
+        self.trips = 0
+        self._armed = False
+        self._replica = -1
+        self._deadline = 0.0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "StepWatchdog":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="step-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def arm(self, replica: int) -> None:
+        with self._lock:
+            self._armed = True
+            self._replica = replica
+            self._deadline = time.monotonic() + self.timeout_s
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        interval = min(self.timeout_s / 4.0, 0.5)
+        while not self._stop.wait(interval):
+            with self._lock:
+                expired = self._armed and time.monotonic() > self._deadline
+                replica = self._replica
+                if expired:
+                    self._armed = False
+            if expired:
+                self._fire(replica)
+
+    def _fire(self, replica: int) -> None:
+        self.trips += 1
+        print(
+            f"[serve] watchdog: replica {replica} step exceeded "
+            f"{self.timeout_s:g}s; dumping stacks, condemning the replica",
+            file=sys.stderr, flush=True,
+        )
+        try:
+            import faulthandler
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        except Exception:
+            pass
+        try:
+            tracer = get_tracer()
+            if tracer.enabled:
+                print("[serve] watchdog: " + tracer.format_open_spans(),
+                      file=sys.stderr, flush=True)
+            tracer.event("watchdog_fired", replica=replica,
+                         timeout_s=self.timeout_s)
+        except Exception:
+            pass
+        try:
+            self.on_trip(replica)
+        except Exception as e:   # the watchdog must keep watching
+            print(f"[serve] watchdog: on_trip raised {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
 
 
 class EngineDriver:
@@ -59,6 +161,9 @@ class EngineDriver:
         preemption=None,
         autoscaler=None,
         autoscale_every: int = 1,
+        request_timeout_s: float | None = None,
+        watchdog_timeout_s: float | None = None,
+        injector=None,
     ):
         self.router = router
         self.tracker = tracker
@@ -67,8 +172,20 @@ class EngineDriver:
         self.preemption = preemption
         self.autoscaler = autoscaler
         self.autoscale_every = max(int(autoscale_every), 1)
+        # Default deadline for every submit (per-request timeout_s wins).
+        self.request_timeout_s = request_timeout_s
+        # resilience.FaultInjector (tests/chaos bench): consulted before
+        # each replica's step; None in production.
+        self.injector = injector
         self.steps = 0
         self.draining = False
+        self.watchdog_trips = 0
+        self._condemned: set[int] = set()
+        self._watchdog: StepWatchdog | None = None
+        if watchdog_timeout_s is not None:
+            self._watchdog = StepWatchdog(
+                watchdog_timeout_s, self._on_watchdog_trip
+            ).start()
         self._watch: list[tuple[RequestHandle, Callable | None]] = []
         self._inbox: collections.deque = collections.deque()
         self._wake = threading.Event()
@@ -85,17 +202,23 @@ class EngineDriver:
         rng=0,
         on_token: Callable[[RequestHandle, int], None] | None = None,
         on_finish: Callable[[RequestHandle], None] | None = None,
+        timeout_s: float | None = None,
     ) -> RequestHandle:
         """Driver-thread submit. Raises :class:`DrainingError` once
         shutdown has begun, :class:`ShedError` from SLO admission, and
-        ``ValueError`` for requests the engine itself would refuse."""
+        ``ValueError`` for requests the engine itself would refuse.
+        ``timeout_s`` overrides the driver-wide ``request_timeout_s``
+        deadline for this request."""
         if self.draining:
             raise DrainingError(
                 "draining: in-flight requests are completing; no new "
                 "submits accepted"
             )
+        if timeout_s is None:
+            timeout_s = self.request_timeout_s
         handle = self.router.submit(
             prompt, max_new_tokens, rng=rng, on_token=on_token,
+            timeout_s=timeout_s,
         )
         self._watch.append((handle, on_finish))
         return handle
@@ -108,6 +231,7 @@ class EngineDriver:
         rng=0,
         on_token: Callable[[RequestHandle, int], None] | None = None,
         on_finish: Callable[[RequestHandle], None] | None = None,
+        timeout_s: float | None = None,
     ) -> concurrent.futures.Future:
         """Cross-thread submit: resolves to the :class:`RequestHandle` at
         the driver's next step boundary, or to the refusal exception."""
@@ -119,23 +243,35 @@ class EngineDriver:
             ))
             return fut
         self._inbox.append(
-            (fut, list(prompt), max_new_tokens, rng, on_token, on_finish)
+            (fut, list(prompt), max_new_tokens, rng, on_token, on_finish,
+             timeout_s)
         )
         self._wake.set()
         return fut
 
     def _consume_inbox(self) -> None:
         while self._inbox:
-            fut, prompt, new, rng, on_token, on_finish = self._inbox.popleft()
+            (fut, prompt, new, rng, on_token, on_finish, timeout_s) = \
+                self._inbox.popleft()
             if not fut.set_running_or_notify_cancel():
                 continue
             try:
                 fut.set_result(self.submit(
                     prompt, new, rng=rng,
                     on_token=on_token, on_finish=on_finish,
+                    timeout_s=timeout_s,
                 ))
             except BaseException as e:  # refusals travel to the caller
                 fut.set_exception(e)
+                # Shed submissions already traced a "shed" event with the
+                # routed rid; draining/validation refusals never reached
+                # the router, so trace them here — with a fleet-unique rid
+                # — or they are invisible to obs_report --frontend.
+                if not isinstance(e, ShedError):
+                    get_tracer().event(
+                        "submit_refused", rid=self.router.allocate_rid(),
+                        reason=type(e).__name__, detail=str(e)[:200],
+                    )
 
     # --------------------------------------------------------------- loop
 
@@ -151,18 +287,65 @@ class EngineDriver:
     def has_work(self) -> bool:
         return bool(self._inbox) or self.router.has_work()
 
+    def _on_watchdog_trip(self, replica: int) -> None:
+        """Watchdog-thread callback: condemn the stuck replica (the step
+        loop fails + migrates it the moment the stuck call returns) and
+        release any injected hang so tests and chaos runs make progress."""
+        self.watchdog_trips += 1
+        self._condemned.add(replica)
+        if self.injector is not None:
+            self.injector.release_hangs()
+
+    def _fail_replica(self, idx: int, reason: str) -> None:
+        """Containment: eject replica ``idx`` from the fleet, migrate its
+        in-flight requests to healthy replicas, keep the loop running."""
+        print(
+            f"[serve] replica {idx} FAILED ({reason}); "
+            f"migrating its in-flight requests",
+            file=sys.stderr, flush=True,
+        )
+        moved = self.router.fail_replica(idx, reason=reason)
+        print(
+            f"[serve] replica {idx}: {moved} request(s) migrated; "
+            f"{self.router.n_active} replica(s) active",
+            file=sys.stderr, flush=True,
+        )
+
     def step(self) -> int:
         """One fleet tick; returns tokens emitted. Mirrors serve.py's
         original per-step ordering: capture start -> engine step(s) ->
-        capture stop -> metrics flush."""
+        capture stop -> metrics flush.
+
+        Each replica's ``step()`` runs inside a containment wrapper: an
+        exception (or a watchdog condemnation) fails THAT replica —
+        ejected from routing, its requests migrated — and the fleet loop
+        keeps going. Before this, one raise at this line killed every
+        in-flight stream on every replica."""
         self._check_preemption()
         self._consume_inbox()
         self.steps += 1
         if self.xla_capture is not None:
             self.xla_capture.maybe_start(self.steps)
         emitted = 0
-        for eng in self.router.engines_with_work():
-            emitted += eng.step()
+        wd = self._watchdog
+        for idx, eng in self.router.steppable():
+            if wd is not None:
+                wd.arm(idx)
+            try:
+                if self.injector is not None:
+                    self.injector.tick(self.steps, idx)
+                emitted += eng.step()
+            except Exception as e:
+                self._fail_replica(idx, f"{type(e).__name__}: {e}")
+                continue
+            finally:
+                if wd is not None:
+                    wd.disarm()
+            if idx in self._condemned:
+                self._condemned.discard(idx)
+                self._fail_replica(
+                    idx, f"watchdog: step exceeded {wd.timeout_s:g}s"
+                )
         if self.xla_capture is not None:
             self.xla_capture.maybe_stop(self.steps)
         if (self.autoscaler is not None
@@ -181,6 +364,7 @@ class EngineDriver:
         tracker = self.tracker
         if tracker is not None and self.steps % self.metrics_every == 0:
             tracker.update(self.steps, count_tokens=False,
+                           watchdog_trips=float(self.watchdog_trips),
                            **self.router.metrics_snapshot())
         return emitted
 
@@ -197,6 +381,7 @@ class EngineDriver:
         tracker = self.tracker
         if tracker is not None:
             tracker.update(self.steps + 1, count_tokens=False,
+                           watchdog_trips=float(self.watchdog_trips),
                            **self.router.metrics_snapshot())
         return total
 
@@ -218,6 +403,13 @@ class EngineDriver:
         self.drain()
         self._finished = True
         self._consume_inbox()  # refuse (DrainingError) anything left parked
+        self.close()
+
+    def close(self) -> None:
+        """Stop the step watchdog thread (idempotent). ``run_forever``
+        calls it on exit; the JSONL path calls it after its final drain."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
 
     def stop(self) -> None:
         """Ask ``run_forever`` to exit once idle (tests, clean shutdown)."""

@@ -31,6 +31,13 @@ its design regime instead of absorbing an unbounded backlog. With
 against the target and violations are counted (``slo_violations``) — the
 autoscaler treats sheds and violations as grow pressure, closing the loop.
 
+Failure containment: ``fail_replica`` permanently ejects a replica whose
+``step()`` raised (or whose watchdog tripped), extracts its in-flight
+requests with their preemption state, and re-submits them to healthy
+replicas as recompute-prefill resumes — the streams continue
+bit-identically with zero re-emitted tokens, because migration is just
+PR 9's preemption with a different destination engine.
+
 Routing changes WHICH replica computes a stream, never WHAT it computes:
 each engine's exactness contract (streams bit-identical to
 ``generate_cached(batch=1)``) is per-request and replica-independent, so
@@ -97,6 +104,12 @@ class ReplicaRouter:
         self.queue_slo_ms = queue_slo_ms
         self.engines: list[ServingEngine] = []
         self._active: list[bool] = []
+        self._failed: list[bool] = []
+        # The fleet size the deployment asked for: /healthz reports
+        # "degraded" while failures hold n_active below this.
+        self.target_replicas = int(replicas)
+        self.replica_failures = 0   # replicas marked FAILED, ever
+        self.migrated = 0           # requests moved off failed replicas
         self._sticky: dict[bytes, int] = {}
         self._rr_next = 0
         # rid_start keeps rids distinct across routers sharing one trace
@@ -119,14 +132,23 @@ class ReplicaRouter:
     def n_active(self) -> int:
         return sum(self._active)
 
+    @property
+    def n_failed(self) -> int:
+        return sum(self._failed)
+
     def active_indices(self) -> list[int]:
         return [i for i, a in enumerate(self._active) if a]
 
+    def failed_indices(self) -> list[int]:
+        return [i for i, f in enumerate(self._failed) if f]
+
     def grow(self) -> int | None:
         """Activate one replica (reviving a parked one before building a
-        new one); returns its index, or None at ``max_replicas``."""
+        new one — FAILED replicas are never revived); returns its index,
+        or None at ``max_replicas``. Failed replicas still count against
+        the ceiling: their pools are abandoned, not reclaimed."""
         for i, a in enumerate(self._active):
-            if not a:
+            if not a and not self._failed[i]:
                 self._active[i] = True
                 get_tracer().event("scale_up", replica=i,
                                    replicas=self.n_active)
@@ -135,9 +157,57 @@ class ReplicaRouter:
             return None
         self.engines.append(self._make_engine())
         self._active.append(True)
+        self._failed.append(False)
         i = len(self.engines) - 1
         get_tracer().event("scale_up", replica=i, replicas=self.n_active)
         return i
+
+    def fail_replica(self, idx: int, reason: str = "step exception") -> int:
+        """Mark replica ``idx`` FAILED and migrate its in-flight requests.
+
+        The replica leaves routing AND the step loop permanently (unlike
+        ``retire``, which parks a healthy engine). Its live requests are
+        extracted with their preemption state (generated tokens + PRNG
+        chain head) and re-enter healthy replicas as recompute-prefill
+        resumes — bit-identical continuation, zero re-emitted tokens. If
+        no replica is active the router tries ``grow()`` once; requests
+        that still have nowhere to go finish with reason ``"failed"``.
+        Returns the number of requests migrated.
+        """
+        if self._failed[idx]:
+            return 0
+        self._failed[idx] = True
+        was_active = self._active[idx]
+        self._active[idx] = False
+        self.replica_failures += 1
+        get_tracer().event(
+            "replica_fail", replica=idx, reason=reason,
+            active=was_active, replicas=self.n_active,
+        )
+        # Sticky entries pointing at the dead replica would miss the
+        # _active guard anyway; drop them so the map stays small.
+        self._sticky = {k: i for k, i in self._sticky.items() if i != idx}
+        try:
+            reqs = self.engines[idx].extract_inflight()
+        except Exception:
+            reqs = []   # engine too corrupt even for host-side extraction
+        if reqs and not self.active_indices():
+            self.grow()
+        moved = 0
+        tracer = get_tracer()
+        for req in reqs:
+            active = self.active_indices()
+            if not active:
+                req._finish("failed")
+                continue
+            dst = min(active, key=lambda i: (self._load(i), i))
+            self.engines[dst].adopt(req)
+            req.replica = dst
+            self.migrated += 1
+            moved += 1
+            tracer.event("migrate", rid=req.id, src=idx, dst=dst,
+                         n_generated=len(req.generated))
+        return moved
 
     def retire(self) -> int | None:
         """Deactivate the least-loaded active replica: no new routes land
@@ -203,6 +273,14 @@ class ReplicaRouter:
 
     # ------------------------------------------------------------- submit
 
+    def allocate_rid(self) -> int:
+        """A fleet-unique request id for trace events about submissions
+        that never reach ``submit`` (draining/validation refusals), so
+        they still get a per-request row in ``obs_report --frontend``."""
+        rid = self._next_rid
+        self._next_rid += 1
+        return rid
+
     def submit(
         self,
         prompt: Sequence[int],
@@ -210,6 +288,7 @@ class ReplicaRouter:
         *,
         rng=0,
         on_token: Callable[[RequestHandle, int], None] | None = None,
+        timeout_s: float | None = None,
     ) -> RequestHandle:
         """Route + submit one request. Raises :class:`ShedError` when the
         queue SLO predicts the wait would blow the target, and the same
@@ -236,6 +315,7 @@ class ReplicaRouter:
                 )
         handle = self.engines[idx].submit(
             prompt, max_new_tokens, rng=rng, on_token=on_token, rid=rid,
+            timeout_s=timeout_s,
         )
         handle.replica = idx
         if how in ("affinity", "sticky"):
@@ -264,12 +344,25 @@ class ReplicaRouter:
     # ------------------------------------------------------------ queries
 
     def has_work(self) -> bool:
-        return any(e.has_work() for e in self.engines)
+        return any(
+            e.has_work() for i, e in enumerate(self.engines)
+            if not self._failed[i]
+        )
 
     def engines_with_work(self) -> list[ServingEngine]:
         """Every engine with queued or in-flight requests — retired
-        replicas included, so parked engines still drain."""
-        return [e for e in self.engines if e.has_work()]
+        replicas included, so parked engines still drain; FAILED replicas
+        excluded, so the step loop never touches a dead engine."""
+        return [e for _, e in self.steppable()]
+
+    def steppable(self) -> list[tuple[int, ServingEngine]]:
+        """(index, engine) pairs the driver should step this tick —
+        ``engines_with_work`` plus the indices the failure-containment
+        wrapper needs to name a crashing replica."""
+        return [
+            (i, e) for i, e in enumerate(self.engines)
+            if not self._failed[i] and e.has_work()
+        ]
 
     def total_queue_depth(self) -> int:
         return sum(e.queue_depth for e in self.engines)
@@ -303,6 +396,11 @@ class ReplicaRouter:
             "serve_shed": float(self.shed_count),
             "route_affinity_hits": float(self.affinity_hits),
             "slo_violations": float(self.slo_violations),
+            "replica_failures": float(self.replica_failures),
+            "requests_migrated": float(self.migrated),
+            "requests_timed_out": float(
+                sum(e.stats["timeouts"] for e in self.engines)
+            ),
         }
 
     def aggregate_hit_rate(self) -> float:

@@ -24,8 +24,17 @@ same seed and config — routing picks WHICH replica computes, never WHAT
 sampled).
 
 Also served: ``GET /healthz`` (503 once draining, so load balancers stop
-sending traffic during shutdown) and ``GET /metrics`` (the router's
-fleet snapshot + driver/autoscaler counters, JSON).
+sending traffic during shutdown; ``"degraded"`` while replica failures
+hold the fleet below its target size, 503 ``"unhealthy"`` when no replica
+is active) and ``GET /metrics`` (the router's fleet snapshot +
+driver/autoscaler counters, JSON).
+
+Fault tolerance: a replica whose ``step()`` raises (or trips the
+``--watchdog_timeout_s`` step watchdog) is FAILED and ejected, its
+in-flight streams migrate to healthy replicas mid-SSE with zero re-emitted
+tokens, and ``--autoscale`` replaces the lost capacity. Requests that
+exceed ``--request_timeout_s`` (or their own ``"timeout_s"`` body field)
+answer 504 with their blocks freed.
 
 Admission failures map to HTTP: a router shed (``--queue_slo_ms``
 exceeded) or a draining server is ``503`` with ``Retry-After``; malformed
@@ -72,7 +81,7 @@ _MAX_HEADER_LINE = 8 * 1024
 _MAX_BODY_BYTES = 4 * 1024 * 1024
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 413: "Payload Too Large",
-            503: "Service Unavailable"}
+            503: "Service Unavailable", 504: "Gateway Timeout"}
 
 
 class _HttpError(Exception):
@@ -104,6 +113,7 @@ class FrontendServer:
         model_name: str = "gpt2",
         default_new: int = 64,
         default_seed: int = 0,
+        join_timeout_s: float = 30.0,
     ):
         self.driver = driver
         self.host = host
@@ -111,6 +121,10 @@ class FrontendServer:
         self.model_name = model_name
         self.default_new = default_new
         self.default_seed = default_seed
+        self.join_timeout_s = float(join_timeout_s)
+        # 0 after a clean drain; 1 when the driver thread outlived the
+        # shutdown join and was abandoned (main() exits with this).
+        self.exit_code = 0
         self.ready = threading.Event()
         self._enc = None
         self._enc_err: str | None = None
@@ -173,8 +187,21 @@ class FrontendServer:
             # `drained`; only then do we stop accepting sockets. Requests
             # that race the drain get 503 from submit, not a dead socket.
             await drained.wait()
-        thread.join(timeout=30)
-        print("frontend: drained, exiting 0", file=sys.stderr)
+        thread.join(timeout=self.join_timeout_s)
+        if thread.is_alive():
+            # A wedged driver thread (stuck compiled call, dead device)
+            # can outlive the drain signal. Silently returning here would
+            # report a clean exit while abandoning a live thread — say so
+            # loudly and make the process exit nonzero instead.
+            print(
+                f"frontend: driver thread STILL ALIVE after "
+                f"{self.join_timeout_s:g}s shutdown join "
+                f"(--shutdown_join_s); abandoning it and exiting 1",
+                file=sys.stderr,
+            )
+            self.exit_code = 1
+        else:
+            print("frontend: drained, exiting 0", file=sys.stderr)
 
     # ------------------------------------------------------------- http
 
@@ -268,16 +295,35 @@ class FrontendServer:
     # ---------------------------------------------------------- routes
 
     async def _healthz(self, writer: asyncio.StreamWriter) -> None:
+        router = self.driver.router
         if self.driver.draining:
             # 503 pulls this replica out of a load balancer's rotation
             # while the drain completes — the whole point of healthz.
             await self._respond_json(
                 writer, 503, {"status": "draining"}, {"Retry-After": "1"},
             )
+        elif router.n_active == 0:
+            await self._respond_json(
+                writer, 503, {
+                    "status": "unhealthy",
+                    "replicas": 0,
+                    "failed_replicas": router.n_failed,
+                }, {"Retry-After": "1"},
+            )
+        elif router.n_failed > 0 and router.n_active < router.target_replicas:
+            # Still serving, but failures hold the fleet below the size
+            # the deployment asked for — 200 (keep routing traffic here)
+            # with the degradation visible to anything that looks.
+            await self._respond_json(writer, 200, {
+                "status": "degraded",
+                "replicas": router.n_active,
+                "target_replicas": router.target_replicas,
+                "failed_replicas": router.n_failed,
+            })
         else:
             await self._respond_json(writer, 200, {
                 "status": "ok",
-                "replicas": self.driver.router.n_active,
+                "replicas": router.n_active,
             })
 
     async def _metrics(self, writer: asyncio.StreamWriter) -> None:
@@ -288,16 +334,20 @@ class FrontendServer:
         out["prefix_hit_rate"] = round(
             self.driver.router.aggregate_hit_rate(), 4
         )
+        out["failed_replicas"] = self.driver.router.n_failed
+        out["watchdog_trips"] = self.driver.watchdog_trips
         scaler = self.driver.autoscaler
         if scaler is not None:
             out["autoscale"] = {"ticks": scaler.ticks,
                                 "scale_ups": scaler.scale_ups,
-                                "scale_downs": scaler.scale_downs}
+                                "scale_downs": scaler.scale_downs,
+                                "replacements": scaler.replacements}
         await self._respond_json(writer, 200, out)
 
-    def _parse_completion(self, body: bytes) -> tuple[list[int], int, int,
-                                                      bool, bool]:
-        """(prompt_ids, max_tokens, seed, stream, echo_text)."""
+    def _parse_completion(
+        self, body: bytes
+    ) -> tuple[list[int], int, int, bool, bool, float | None]:
+        """(prompt_ids, max_tokens, seed, stream, echo_text, timeout_s)."""
         try:
             obj = json.loads(body.decode() or "null")
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
@@ -334,11 +384,23 @@ class FrontendServer:
             raise _HttpError(
                 400, f"'max_tokens' / 'seed' must be integers ({e})"
             ) from e
-        return ids, new, seed, bool(obj.get("stream", False)), want_text
+        timeout_s = obj.get("timeout_s")   # None -> --request_timeout_s
+        if timeout_s is not None:
+            try:
+                timeout_s = float(timeout_s)
+            except (TypeError, ValueError) as e:
+                raise _HttpError(
+                    400, f"'timeout_s' must be a number ({e})"
+                ) from e
+            if timeout_s < 0:
+                raise _HttpError(400, "'timeout_s' must be >= 0")
+        return (ids, new, seed, bool(obj.get("stream", False)), want_text,
+                timeout_s)
 
     async def _completions(self, writer: asyncio.StreamWriter,
                            body: bytes) -> None:
-        ids, new, seed, stream, want_text = self._parse_completion(body)
+        ids, new, seed, stream, want_text, timeout_s = \
+            self._parse_completion(body)
         if self.driver.draining:
             raise _HttpError(503, "server is draining toward shutdown",
                              err_type="overloaded", retry_after=1)
@@ -356,6 +418,7 @@ class FrontendServer:
             handle = await asyncio.wrap_future(self.driver.submit_threadsafe(
                 ids, new, rng=seed,
                 on_token=on_token if stream else None, on_finish=on_finish,
+                timeout_s=timeout_s,
             ))
         except ShedError as e:
             raise _HttpError(503, str(e), err_type="overloaded",
@@ -374,6 +437,20 @@ class FrontendServer:
                 if kind == "finish":
                     handle = payload
                     break
+            if handle.finish_reason == "timeout":
+                raise _HttpError(
+                    504,
+                    f"request {handle.id} exceeded its deadline after "
+                    f"{len(handle.generated)} token(s)",
+                    err_type="timeout",
+                )
+            if handle.finish_reason == "failed":
+                raise _HttpError(
+                    503,
+                    f"request {handle.id} lost its replica with no healthy "
+                    f"replica to migrate to",
+                    err_type="server_error", retry_after=1,
+                )
             await self._respond_json(writer, 200, {
                 "id": cid,
                 "object": "text_completion",
@@ -452,6 +529,7 @@ class FrontendServer:
 def build_argparser() -> argparse.ArgumentParser:
     from gpt_2_distributed_tpu.serving.serve import (
         add_engine_flags,
+        add_fault_flags,
         add_model_flags,
         add_obs_flags,
     )
@@ -460,6 +538,10 @@ def build_argparser() -> argparse.ArgumentParser:
     add_model_flags(p)
     add_engine_flags(p)
     add_obs_flags(p)
+    add_fault_flags(p)
+    p.add_argument("--shutdown_join_s", type=float, default=30.0,
+                   help="how long shutdown waits for the driver thread "
+                        "before abandoning it and exiting 1")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="TCP port; 0 picks an ephemeral port")
@@ -511,6 +593,7 @@ def main(argv: list[str] | None = None) -> None:
     from gpt_2_distributed_tpu.serving.serve import (
         build_serve_config,
         load_model,
+        make_injector,
         make_tracker,
         setup_observability,
     )
@@ -550,10 +633,14 @@ def main(argv: list[str] | None = None) -> None:
         router, tracker=make_tracker(args), metrics_every=args.metrics_every,
         xla_capture=xla_capture, preemption=handler, autoscaler=autoscaler,
         autoscale_every=args.autoscale_every,
+        request_timeout_s=args.request_timeout_s,
+        watchdog_timeout_s=args.watchdog_timeout_s,
+        injector=make_injector(p, args),
     )
     server = FrontendServer(
         driver, host=args.host, port=args.port, model_name=args.model,
         default_new=args.new, default_seed=args.seed,
+        join_timeout_s=args.shutdown_join_s,
     )
     try:
         server.run()
@@ -562,6 +649,8 @@ def main(argv: list[str] | None = None) -> None:
             driver.tracker.close()
         get_tracer().close()
         handler.uninstall()
+    if server.exit_code:
+        sys.exit(server.exit_code)
 
 
 if __name__ == "__main__":

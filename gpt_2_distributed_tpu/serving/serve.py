@@ -115,6 +115,67 @@ def add_obs_flags(p: argparse.ArgumentParser) -> None:
                    help="jax platform override (cpu|tpu)")
 
 
+def add_fault_flags(p: argparse.ArgumentParser) -> None:
+    """Fault-tolerance + fault-injection flags, shared with the front end
+    and the chaos bench."""
+    p.add_argument("--request_timeout_s", type=float, default=None,
+                   help="per-request deadline from submission (queue wait "
+                        "included); overdue requests are evicted with "
+                        "finish reason 'timeout' (HTTP 504 on the front "
+                        "end) and their KV blocks freed")
+    p.add_argument("--watchdog_timeout_s", type=float, default=None,
+                   help="fail a replica whose single step() exceeds this "
+                        "(stacks + open trace spans dumped, in-flight "
+                        "requests migrated to healthy replicas)")
+    p.add_argument("--inject_replica_fail_at", default=None,
+                   metavar="STEP[:REPLICA]",
+                   help="fault injection: raise inside the given replica's "
+                        "step (default replica 0) at fleet step STEP")
+    p.add_argument("--inject_replica_hang_at", default=None,
+                   metavar="STEP[:REPLICA]",
+                   help="fault injection: hang the given replica's step at "
+                        "fleet step STEP until the watchdog trips")
+    p.add_argument("--inject_step_exception", type=int, default=None,
+                   metavar="STEP",
+                   help="fault injection: raise in whichever replica steps "
+                        "first at fleet step STEP")
+
+
+def make_injector(p: argparse.ArgumentParser, args: argparse.Namespace):
+    """Validate the fault flags; return a :class:`resilience.FaultInjector`
+    or None when no injection was asked for. Import-light (no jax) so
+    ``bench_serve`` can validate at parse time."""
+    from gpt_2_distributed_tpu.resilience import (
+        FaultInjector,
+        parse_fault_spec,
+    )
+
+    if args.request_timeout_s is not None and args.request_timeout_s < 0:
+        p.error(f"--request_timeout_s={args.request_timeout_s} must be >= 0")
+    if args.watchdog_timeout_s is not None and args.watchdog_timeout_s <= 0:
+        p.error(f"--watchdog_timeout_s={args.watchdog_timeout_s} "
+                f"must be > 0")
+    try:
+        fail_at = (parse_fault_spec(args.inject_replica_fail_at,
+                                    "--inject_replica_fail_at")
+                   if args.inject_replica_fail_at else None)
+        hang_at = (parse_fault_spec(args.inject_replica_hang_at,
+                                    "--inject_replica_hang_at")
+                   if args.inject_replica_hang_at else None)
+    except ValueError as e:
+        p.error(str(e))
+    exc_at = args.inject_step_exception
+    if exc_at is not None and exc_at < 1:
+        p.error(f"--inject_step_exception={exc_at} must be >= 1")
+    if hang_at is not None and args.watchdog_timeout_s is None:
+        p.error("--inject_replica_hang_at needs --watchdog_timeout_s "
+                "(nothing else ever detects the hang)")
+    if fail_at is None and hang_at is None and exc_at is None:
+        return None
+    return FaultInjector(fail_at=fail_at, hang_at=hang_at,
+                         exception_at=exc_at)
+
+
 def build_argparser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     add_model_flags(p)
@@ -124,6 +185,7 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--stream", action="store_true",
                    help="emit a JSON line per token as it is generated")
     add_obs_flags(p)
+    add_fault_flags(p)
     return p
 
 
@@ -264,8 +326,15 @@ def main(argv: list[str] | None = None) -> None:
                 ids = enc.encode_ordinary(obj["prompt"])
             else:
                 ids = [int(t) for t in obj["prompt_ids"]]
+            timeout_s = obj.get("timeout_s")
+            if timeout_s is not None:
+                try:
+                    timeout_s = float(timeout_s)
+                except (TypeError, ValueError):
+                    sys.exit(f"--requests line {ln}: 'timeout_s' must be "
+                             f"a number")
             specs.append((ids, int(obj.get("new", args.new)),
-                          int(obj.get("seed", args.seed))))
+                          int(obj.get("seed", args.seed)), timeout_s))
     if not specs:
         sys.exit("--requests: no requests")
 
@@ -283,7 +352,10 @@ def main(argv: list[str] | None = None) -> None:
     handler = PreemptionHandler(notice=DRAIN_NOTICE).install()
     driver = EngineDriver(router, tracker=tracker,
                           metrics_every=args.metrics_every,
-                          xla_capture=xla_capture, preemption=handler)
+                          xla_capture=xla_capture, preemption=handler,
+                          request_timeout_s=args.request_timeout_s,
+                          watchdog_timeout_s=args.watchdog_timeout_s,
+                          injector=make_injector(p, args))
 
     def on_token(req, tok):
         if args.stream:
@@ -291,15 +363,17 @@ def main(argv: list[str] | None = None) -> None:
 
     t0 = time.monotonic()
     handles = []
-    for ids, new, seed in specs:
+    for ids, new, seed, timeout_s in specs:
         # ValueError here (prompt too long, new<1, ...) is a bad REQUEST:
         # report and fail loudly rather than serving the rest silently.
         try:
             handles.append(driver.submit(ids, new, rng=seed,
-                                         on_token=on_token))
+                                         on_token=on_token,
+                                         timeout_s=timeout_s))
         except ValueError as e:
             sys.exit(f"request {len(handles)}: {e}")
     driver.drain()
+    driver.close()
     if tracker is not None:
         tracker.close()
     get_tracer().close()
@@ -313,7 +387,10 @@ def main(argv: list[str] | None = None) -> None:
             "generated": h.generated,
             "text": enc.decode(h.generated) if enc is not None else None,
             "finish_reason": h.finish_reason,
-            "ttft_ms": round((h.first_token_time - h.submit_time) * 1e3, 2),
+            # A request can time out (or lose its replica) before its
+            # first token: no TTFT to report then.
+            "ttft_ms": (round((h.first_token_time - h.submit_time) * 1e3, 2)
+                        if h.first_token_time is not None else None),
             "queue_wait_ms": round(h.queue_wait_ms, 2),
             "preempted": h.preemptions,
             "prefix_cached_tokens": h.prefix_cached_tokens,

@@ -168,9 +168,37 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--queue_slo_ms", type=float, default=None,
                    help="front-door mode: shed arrivals whose predicted "
                    "queue wait exceeds this")
+    # Chaos mode + fault injection (PR 16). Mirrors gpt2-tpu-serve's
+    # add_fault_flags — duplicated rather than imported because pulling in
+    # serving.serve drags jax through the package __init__, and this CLI's
+    # contract is that --help and flag validation never touch jax.
+    p.add_argument("--chaos", action="store_true",
+                   help="chaos mode: replay the closed trace on a replica "
+                   "fleet, kill one replica mid-run (default "
+                   "--inject_replica_fail_at 20:0), and verify every "
+                   "stream is bit-identical to an unfailed reference "
+                   "replay; merges a 'chaos' record into --json")
+    p.add_argument("--request_timeout_s", type=float, default=None,
+                   help="per-request deadline from submission; overdue "
+                   "requests finish with reason 'timeout'")
+    p.add_argument("--watchdog_timeout_s", type=float, default=None,
+                   help="fail a replica whose single step() exceeds this")
+    p.add_argument("--inject_replica_fail_at", default=None,
+                   metavar="STEP[:REPLICA]",
+                   help="raise inside the given replica's step (default "
+                   "replica 0) at fleet step STEP")
+    p.add_argument("--inject_replica_hang_at", default=None,
+                   metavar="STEP[:REPLICA]",
+                   help="hang the given replica's step at fleet step STEP "
+                   "until the watchdog trips (needs --watchdog_timeout_s)")
+    p.add_argument("--inject_step_exception", type=int, default=None,
+                   metavar="STEP",
+                   help="raise in whichever replica steps first at fleet "
+                   "step STEP")
     p.add_argument("--json", default="BENCH_SERVE.json", metavar="PATH",
                    help="result file ('' disables the write); front-door "
-                   "mode merges a 'frontend' record into an existing file")
+                   "and chaos modes merge their record into an existing "
+                   "file")
     p.add_argument("--trace_dir", default=None,
                    help="write span/event trace JSONL here (obs/trace.py)")
     p.add_argument("--xla_profile_at", default=None, metavar="STEP[:NSTEPS]",
@@ -225,6 +253,54 @@ def validate_args(p: argparse.ArgumentParser, args: argparse.Namespace) -> None:
         if args.max_replicas is not None and args.max_replicas < args.replicas:
             p.error(f"--max_replicas {args.max_replicas} < --replicas "
                     f"{args.replicas}")
+    # Fault injection / chaos (parsed here, jax-free, mirroring
+    # resilience.parse_fault_spec; the injector itself is built in main
+    # after the jax import).
+    def _fault_spec(flag, spec):
+        if spec is None:
+            return None
+        parts = str(spec).split(":")
+        try:
+            step = int(parts[0])
+            replica = int(parts[1]) if len(parts) > 1 else None
+            if len(parts) > 2 or step < 1 or (replica is not None
+                                              and replica < 0):
+                raise ValueError
+        except ValueError:
+            p.error(f"{flag}={spec!r}: expected STEP[:REPLICA] with "
+                    "STEP >= 1 and REPLICA >= 0")
+        return step, replica
+
+    args.fail_spec = _fault_spec("--inject_replica_fail_at",
+                                 args.inject_replica_fail_at)
+    args.hang_spec = _fault_spec("--inject_replica_hang_at",
+                                 args.inject_replica_hang_at)
+    if args.inject_step_exception is not None and args.inject_step_exception < 1:
+        p.error(f"--inject_step_exception={args.inject_step_exception}: "
+                "must be >= 1")
+    if args.request_timeout_s is not None and args.request_timeout_s < 0:
+        p.error(f"--request_timeout_s={args.request_timeout_s}: must be >= 0")
+    if args.watchdog_timeout_s is not None and args.watchdog_timeout_s <= 0:
+        p.error(f"--watchdog_timeout_s={args.watchdog_timeout_s}: "
+                "must be > 0")
+    if args.hang_spec is not None and args.watchdog_timeout_s is None:
+        p.error("--inject_replica_hang_at needs --watchdog_timeout_s "
+                "(nothing else ever detects the hang)")
+    any_inject = (args.fail_spec is not None or args.hang_spec is not None
+                  or args.inject_step_exception is not None)
+    if args.chaos:
+        if args.duration > 0:
+            p.error("--chaos replays the closed trace; drop --duration")
+        if args.baseline_only or args.no_pr7 or args.no_baseline:
+            p.error("--chaos does not run the closed-trace comparisons; "
+                    "drop the baseline flags")
+        if args.replicas < 2:
+            p.error(f"--chaos needs --replicas >= 2, got {args.replicas} "
+                    "(a one-replica fleet has nowhere to migrate)")
+    elif any_inject and args.duration == 0:
+        p.error("fault injection needs --chaos or --duration (front-door "
+                "mode): the single-engine closed-trace bench has no "
+                "driver to contain failures")
     if args.xla_profile_at is not None:
         from gpt_2_distributed_tpu.obs.trace import parse_profile_at
 
@@ -419,7 +495,8 @@ def run_engine(args, params, config, serve, trace, jax, np, make_engine):
     return best
 
 
-def run_frontend(args, config, serve, jax, np, make_engine, policy):
+def run_frontend(args, config, serve, jax, np, make_engine, policy,
+                 injector=None):
     """Open-loop Poisson load for --duration seconds against the replica
     router (optionally autoscaled), then drain; returns the record.
 
@@ -450,7 +527,10 @@ def run_frontend(args, config, serve, jax, np, make_engine, policy):
     scaler = (Autoscaler(router, min_replicas=args.replicas,
                          max_replicas=max_replicas)
               if max_replicas > args.replicas else None)
-    driver = EngineDriver(router, autoscaler=scaler, autoscale_every=8)
+    driver = EngineDriver(router, autoscaler=scaler, autoscale_every=8,
+                          request_timeout_s=args.request_timeout_s,
+                          watchdog_timeout_s=args.watchdog_timeout_s,
+                          injector=injector)
 
     # Warm the initial replicas' prompt-length buckets directly (bypassing
     # the router so its counters stay clean), then reset engine stats.
@@ -513,10 +593,14 @@ def run_frontend(args, config, serve, jax, np, make_engine, policy):
         else:
             break
     wall = time.monotonic() - t0
+    driver.close()
 
     assert all(h.done for h in handles)
     emitted = sum(len(h.generated) for h in handles)
-    ttfts = [h.first_token_time - arrivals[h.id] for h in handles]
+    # A request can finish by timeout/replica-failure before its first
+    # token when deadlines or fault injection are armed.
+    ttfts = [h.first_token_time - arrivals[h.id] for h in handles
+             if h.first_token_time is not None]
     ttft_p50, ttft_p99 = percentiles(ttfts, np)
     per_replica = [len([h for h in handles if h.replica == i])
                    for i in range(len(router.engines))]
@@ -539,7 +623,131 @@ def run_frontend(args, config, serve, jax, np, make_engine, policy):
     if scaler is not None:
         rec["scale_ups"] = scaler.scale_ups
         rec["scale_downs"] = scaler.scale_downs
+    if injector is not None or args.request_timeout_s is not None:
+        rec["replica_failures"] = router.replica_failures
+        rec["requests_migrated"] = router.migrated
+        rec["watchdog_trips"] = driver.watchdog_trips
+        rec["timeouts"] = sum(h.finish_reason == "timeout" for h in handles)
     return rec
+
+
+def run_chaos(args, config, serve, jax, np, make_engine, make_inj):
+    """Closed-trace replay on a replica fleet, twice: once clean (the
+    reference) and once with the configured fault injected mid-run. Every
+    request must stream the exact same tokens in both runs — replica
+    failure, migration and watchdog trips may cost time, never tokens.
+    Returns the chaos record: recovery time, migrated-stream count, and
+    the bit-parity verdict."""
+    from gpt_2_distributed_tpu.serving.frontend.driver import EngineDriver
+    from gpt_2_distributed_tpu.serving.frontend.router import ReplicaRouter
+
+    shared = args.traces != "original"
+    trace = make_trace(args, np, config.vocab_size, shared=shared)
+    arrivals, prompts, news, meta = trace
+    n = len(prompts)
+    keys = [jax.random.PRNGKey(args.trace_seed * 100_000 + i)
+            for i in range(n)]
+
+    def replay(injector):
+        router = ReplicaRouter(lambda: make_engine(serve),
+                               replicas=args.replicas,
+                               max_replicas=args.replicas, policy=args.route)
+        driver = EngineDriver(
+            router, request_timeout_s=args.request_timeout_s,
+            watchdog_timeout_s=args.watchdog_timeout_s, injector=injector,
+        )
+        # Same per-replica compile warmup as the front-door mode.
+        bs = serve.block_size
+        cap = config.n_positions - 2
+        longest = max(len(pr) for pr in prompts)
+        buckets = ({-(-longest // bs)} if serve.prefill_chunk else
+                   {-(-len(pr) // bs) for pr in prompts})
+        for eng in router.engines:
+            for nb in sorted(buckets):
+                eng.submit([3 + nb] * min(nb * bs, cap), 2, rng=0)
+            eng.run_until_idle()
+            eng.clear_prefix_cache()
+            eng.stats = {k: type(v)() for k, v in eng.stats.items()}
+
+        tok_times: dict[int, list[float]] = {}
+
+        def on_token(req, _tok, _tt=tok_times):
+            _tt.setdefault(req.id, []).append(time.monotonic())
+
+        handles = []
+        placed: dict[int, int] = {}    # rid -> replica routed to at submit
+        t_fail = None
+        nxt = 0
+        t0 = time.monotonic()
+        while nxt < n or driver.has_work():
+            now = time.monotonic() - t0
+            while nxt < n and arrivals[nxt] <= now:
+                h = driver.submit(prompts[nxt], int(news[nxt]),
+                                  rng=keys[nxt], on_token=on_token)
+                placed[h.id] = h.replica
+                handles.append(h)
+                nxt += 1
+            if driver.has_work():
+                driver.step()
+                if t_fail is None and router.replica_failures:
+                    t_fail = time.monotonic()
+            elif nxt < n:
+                time.sleep(min(0.001, max(0.0, arrivals[nxt] - now)))
+        wall = time.monotonic() - t0
+        driver.close()
+        assert all(h.done for h in handles)
+
+        migrated = [h for h in handles if h.replica != placed[h.id]]
+        recovery = None
+        if t_fail is not None and migrated:
+            # Failure detection -> every migrated stream has resumed
+            # (emitted its first post-failure token).
+            resumed = [min((t for t in tok_times.get(h.id, [])
+                            if t > t_fail), default=None) for h in migrated]
+            if all(r is not None for r in resumed):
+                recovery = max(resumed) - t_fail
+        emitted = sum(len(h.generated) for h in handles)
+        rec = {
+            "wall_s": round(wall, 4),
+            "tok_s": round(emitted / wall, 1),
+            "completed": sum(h.finish_reason in ("eos", "length")
+                             for h in handles),
+            "replica_failures": router.replica_failures,
+            "migrated_streams": router.migrated,
+            "watchdog_trips": driver.watchdog_trips,
+            "timeouts": sum(h.finish_reason == "timeout" for h in handles),
+            "failed_streams": sum(h.finish_reason == "failed"
+                                  for h in handles),
+            # on_token calls beyond len(generated) would be re-emits; the
+            # migration contract is zero
+            "re_emitted_tokens": sum(
+                len(tok_times.get(h.id, [])) - len(h.generated)
+                for h in handles
+            ),
+            "recovery_s": (round(recovery, 4) if recovery is not None
+                           else None),
+        }
+        return rec, [list(h.generated) for h in handles]
+
+    ref_rec, ref_streams = replay(None)
+    chaos_rec, chaos_streams = replay(make_inj())
+    chaos_rec["streams_bit_identical"] = chaos_streams == ref_streams
+    return {
+        "trace": meta,
+        "replicas": args.replicas,
+        "policy": args.route,
+        "fail_at": args.inject_replica_fail_at,
+        "hang_at": args.inject_replica_hang_at,
+        "step_exception_at": args.inject_step_exception,
+        "serve": {"max_batch": serve.max_batch,
+                  "block_size": serve.block_size,
+                  "num_blocks": serve.num_blocks,
+                  "prefill_chunk": serve.prefill_chunk,
+                  "prefix_cache": serve.prefix_cache,
+                  "admission": serve.admission},
+        "reference": ref_rec,
+        "chaos": chaos_rec,
+    }
 
 
 def main(argv=None) -> None:
@@ -610,6 +818,54 @@ def main(argv=None) -> None:
         return ServingEngine(params, config, serve,
                              temperature=args.temperature, top_k=args.top_k)
 
+    if args.chaos and (args.fail_spec is None and args.hang_spec is None
+                       and args.inject_step_exception is None):
+        # Default chaos kill: replica 0, mid-run on the default trace.
+        args.fail_spec = (20, 0)
+        args.inject_replica_fail_at = "20:0"
+
+    def make_inj():
+        """Fresh injector per measured run (an injector fires once)."""
+        from gpt_2_distributed_tpu.resilience import FaultInjector
+
+        if (args.fail_spec is None and args.hang_spec is None
+                and args.inject_step_exception is None):
+            return None
+        return FaultInjector(fail_at=args.fail_spec,
+                             hang_at=args.hang_spec,
+                             exception_at=args.inject_step_exception)
+
+    if args.chaos:
+        serve_new, _ = serve_pair(
+            args.num_blocks_shared or args.num_blocks
+            if args.traces != "original" else args.num_blocks
+        )
+        rec = run_chaos(args, config, serve_new, jax, np, make_engine,
+                        make_inj)
+        _XLA_CAPTURE.stop_if_active()
+        get_tracer().close()
+        if args.json:
+            out = {"bench": "serve",
+                   "device": jax.devices()[0].device_kind,
+                   "n_devices": jax.device_count(),
+                   "model": {"preset": args.model, **overrides}}
+            if os.path.exists(args.json):
+                with open(args.json) as f:
+                    out = json.load(f)
+            out["chaos"] = rec
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps({"chaos": rec}))
+        if rec["chaos"]["replica_failures"] == 0:
+            sys.exit("chaos: the injected fault never fired — the run "
+                     "finished before its trigger step; lower "
+                     "--inject_replica_fail_at")
+        if not rec["chaos"]["streams_bit_identical"]:
+            sys.exit("chaos: token streams diverged from the unfailed "
+                     "reference replay — migration broke bit-exactness")
+        return
+
     if args.duration > 0:
         # Front-door mode: measured run under --route, plus a round_robin
         # control on the same seed — the affinity-vs-spray comparison the
@@ -632,11 +888,13 @@ def main(argv=None) -> None:
                       "prefix_cache": serve_new.prefix_cache,
                       "admission": serve_new.admission},
             args.route: run_frontend(args, config, serve_new, jax, np,
-                                     make_engine, args.route),
+                                     make_engine, args.route,
+                                     injector=make_inj()),
         }
         if args.route != "round_robin":
             rec["round_robin_control"] = run_frontend(
-                args, config, serve_new, jax, np, make_engine, "round_robin"
+                args, config, serve_new, jax, np, make_engine,
+                "round_robin", injector=make_inj(),
             )
         _XLA_CAPTURE.stop_if_active()
         get_tracer().close()

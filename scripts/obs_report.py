@@ -148,10 +148,13 @@ def step_breakdown(
 # Lifecycle events that mark a request's trajectory, in waterfall order.
 # route/shed come from the replica router (serving/frontend/router.py) —
 # route precedes submit (the router picks a replica, then enqueues), and a
-# shed request has a route event but no submit at all.
+# shed request has a route event but no submit at all.  submit_refused comes
+# from the driver inbox (a non-shed refusal: draining, bad args); migrate
+# from the router's failure-containment path when a replica dies mid-flight.
 _REQUEST_EVENTS = (
     "route",
     "shed",
+    "submit_refused",
     "submit",
     "admit",
     "prefix_hit",
@@ -159,6 +162,7 @@ _REQUEST_EVENTS = (
     "prefill_chunk",
     "first_token",
     "preempt",
+    "migrate",
     "resume",
     "finish",
 )
@@ -198,12 +202,22 @@ def request_waterfall(records: list[dict[str, Any]]) -> dict[str, Any] | None:
                 row["prefix_cached_tokens"] = a["tokens"]
             if e["name"] == "finish" and "n_generated" in a:
                 row["n_generated"] = a["n_generated"]
+            if e["name"] == "finish" and a.get("reason") == "timeout":
+                row["timed_out"] = True
+            if e["name"] == "finish" and a.get("reason") == "failed":
+                row["failed"] = True
             if e["name"] == "route":
                 row["replica"] = a.get("replica")
                 row["route_policy"] = a.get("policy")
                 row["affinity_blocks"] = a.get("affinity_blocks")
             if e["name"] == "shed":
                 row["shed"] = True
+            if e["name"] == "submit_refused":
+                row["refused"] = True
+                row["refuse_reason"] = a.get("reason")
+            if e["name"] == "migrate":
+                row["migrated"] = True
+                row["migrated_to"] = a.get("dst")
         requests.append(row)
 
     return {
@@ -219,7 +233,8 @@ def frontend_summary(serving: dict[str, Any] | None) -> dict[str, Any] | None:
     if not serving:
         return None
     routed = [r for r in serving["requests"] if "replica" in r]
-    if not routed:
+    refused = [r for r in serving["requests"] if r.get("refused")]
+    if not routed and not refused:
         return None
     sheds = [r for r in serving["requests"] if r.get("shed")]
     per_replica: dict[str, int] = defaultdict(int)
@@ -231,12 +246,17 @@ def frontend_summary(serving: dict[str, Any] | None) -> dict[str, Any] | None:
     return {
         "n_routed": len(routed),
         "n_shed": len(sheds),
+        "n_refused": len(refused),
+        "n_migrated": sum(1 for r in routed if r.get("migrated")),
+        "n_timed_out": sum(
+            1 for r in serving["requests"] if r.get("timed_out")),
+        "n_failed": sum(1 for r in serving["requests"] if r.get("failed")),
         "requests_per_replica": dict(sorted(per_replica.items())),
         "routes_by_policy": dict(sorted(per_policy.items())),
         "affinity_share": round(
             (per_policy.get("affinity", 0) + per_policy.get("sticky", 0))
             / len(routed), 4
-        ),
+        ) if routed else 0.0,
     }
 
 
@@ -298,23 +318,40 @@ def _print_frontend(report: dict[str, Any], limit: int) -> None:
     token, with the router's placement decision on every row."""
     fs = report["frontend"]
     s = report["serving"]
-    print(f"\n== front end: {fs['n_routed']} routed, {fs['n_shed']} shed ==")
+    print(f"\n== front end: {fs['n_routed']} routed, {fs['n_shed']} shed, "
+          f"{fs['n_refused']} refused ==")
     print(f"  requests/replica: {fs['requests_per_replica']}  "
           f"routes by policy: {fs['routes_by_policy']}  "
           f"affinity share: {fs['affinity_share']:.0%}")
+    if fs["n_migrated"] or fs["n_timed_out"] or fs["n_failed"]:
+        print(f"  fault tolerance: {fs['n_migrated']} migrated, "
+              f"{fs['n_timed_out']} timed out, {fs['n_failed']} failed")
     print(f"  {'rid':<8} {'replica':>7} {'policy':<12} {'aff_blk':>7} "
           f"{'queue_ms':>9} {'ttft_ms':>9} {'finish_ms':>10}")
     shown = 0
     for row in s["requests"]:
-        if "replica" not in row or shown >= limit:
+        if ("replica" not in row and not row.get("refused")) or shown >= limit:
             continue
         shown += 1
+        if row.get("refused"):
+            print(f"  {str(row['rid']):<8} {'—':>7} "
+                  f"{str(row.get('refuse_reason')):<12} {'':>7} "
+                  f"{'— refused':>31}")
+            continue
         if row.get("shed"):
             print(f"  {str(row['rid']):<8} {row['replica']:>7} "
                   f"{str(row.get('route_policy')):<12} "
                   f"{row.get('affinity_blocks', 0):>7} "
                   f"{'— shed (503)':>31}")
             continue
+        # a migrated row finished on a different replica than it was routed to
+        mark = ""
+        if row.get("migrated"):
+            mark = f"  → r{row.get('migrated_to')} (migrated)"
+        elif row.get("timed_out"):
+            mark = "  — timeout (504)"
+        elif row.get("failed"):
+            mark = "  — failed (503)"
         print(
             f"  {str(row['rid']):<8} {row['replica']:>7} "
             f"{str(row.get('route_policy')):<12} "
@@ -322,6 +359,7 @@ def _print_frontend(report: dict[str, Any], limit: int) -> None:
             f"{row.get('admit_ms', float('nan')):>9.2f} "
             f"{row.get('first_token_ms', float('nan')):>9.2f} "
             f"{row.get('finish_ms', float('nan')):>10.2f}"
+            f"{mark}"
         )
 
 

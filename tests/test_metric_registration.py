@@ -184,6 +184,11 @@ def test_update_call_sites_found():
     assert "fused_fallback" in names   # the bug this test exists to catch
     assert "queue_wait_ms" in names    # **router.metrics_snapshot()
     assert "route_affinity_hits" in names  # fleet-level router key
+    # PR 16 fault-tolerance counters: snapshot splat + direct kwarg
+    assert "replica_failures" in names     # **router.metrics_snapshot()
+    assert "requests_migrated" in names    # **router.metrics_snapshot()
+    assert "requests_timed_out" in names   # **router.metrics_snapshot()
+    assert "watchdog_trips" in names       # direct kwarg (driver.step/drain)
 
 
 def test_every_pushed_metric_is_registered():
