@@ -18,7 +18,11 @@ from __future__ import annotations
 import dataclasses
 from dataclasses import dataclass
 
-from gpt_2_distributed_tpu.ops.losses import DEFAULT_BLOCK_ROWS
+# Row-chunk default for the blocked CE (ops/losses.py imports it back from
+# here). Defined in config — NOT in ops — so this module stays importable
+# without jax: CLIs (scripts/bench_serve.py) validate flags, including
+# serving mesh specs, before any jax import.
+DEFAULT_BLOCK_ROWS = 1024
 
 
 @dataclass(frozen=True)
@@ -93,8 +97,8 @@ class GPT2Config:
     # to the unfused composition, recorded via the `fused_fallback` metric.
     fused_matmul: str = "off"
     # Row-chunk size of the blocked CE ([rows, V] transient logits per
-    # chunk). The default (ops/losses.py DEFAULT_BLOCK_ROWS — single source
-    # of truth) is the measured v5e throughput optimum at 124M/345M
+    # chunk). The default (DEFAULT_BLOCK_ROWS above — single source of
+    # truth) is the measured v5e throughput optimum at 124M/345M
     # (PERF_ANALYSIS.md §7 — larger chunks pipeline worse); smaller values
     # trade a little throughput for peak-HBM headroom on memory-edge
     # configs (each halving cuts the fp32+bf16 chunk transients roughly in
@@ -335,6 +339,23 @@ class ServeConfig:
       instead of head-of-line blocking.
     * ``watermark_blocks`` — free-block floor the watermark admission
       keeps as decode-growth headroom.
+
+    Multi-chip knobs:
+
+    * ``mesh`` — serving mesh spec, ``"data:N[,tp:M]"`` (``=`` also accepted
+      as the separator; ``""`` = single-device engine, the default). ``data``
+      shards the ``max_batch`` decode rows and the KV block pool over N
+      devices (each shard owns ``max_batch/N`` slot rows and
+      ``num_blocks/N`` blocks); ``tp`` shards the qkv-projection heads and
+      the pool's head axis over M devices. Only reduction-preserving dims
+      are sharded, so streams stay bit-identical to the single-device
+      engine for any mesh shape. The mesh shape is part of the compile
+      signature: one decode compile per (ServeConfig, mesh shape).
+    * ``prefill_batch`` — max queued prompts admitted into ONE chunked
+      prefill dispatch per engine step (multi-row admission). 1 = the
+      one-chunk-per-step behavior. Only meaningful with
+      ``prefill_chunk > 0``; the row count is padded to ``prefill_batch``
+      so the batched chunk program still compiles exactly once.
     """
 
     max_batch: int = 8
@@ -346,6 +367,8 @@ class ServeConfig:
     prefix_cache: bool = False
     admission: str = "reserve"
     watermark_blocks: int = 1
+    mesh: str = ""
+    prefill_batch: int = 1
 
     def __post_init__(self) -> None:
         if self.max_batch < 1:
@@ -378,11 +401,85 @@ class ServeConfig:
             raise ValueError(
                 f"watermark_blocks={self.watermark_blocks} must be >= 0"
             )
+        data, tp = self.mesh_axes()  # raises on a malformed spec
+        if self.max_batch % data != 0:
+            raise ValueError(
+                f"mesh={self.mesh!r}: max_batch={self.max_batch} must be "
+                f"divisible by the data degree {data} (each shard owns "
+                f"max_batch/data slot rows)"
+            )
+        if self.num_blocks % data != 0:
+            raise ValueError(
+                f"mesh={self.mesh!r}: num_blocks={self.num_blocks} must be "
+                f"divisible by the data degree {data} (each shard owns "
+                f"num_blocks/data pool blocks)"
+            )
+        if data > 1 and self.num_blocks // data < 2:
+            raise ValueError(
+                f"mesh={self.mesh!r}: num_blocks={self.num_blocks} leaves "
+                f"shard 0 no usable blocks (it also hosts the reserved null "
+                f"block 0); need num_blocks/data >= 2"
+            )
+        if not 1 <= self.prefill_batch <= self.max_batch:
+            raise ValueError(
+                f"prefill_batch={self.prefill_batch} must be in "
+                f"[1, max_batch={self.max_batch}]"
+            )
+
+    def mesh_axes(self) -> tuple[int, int]:
+        """Parse ``mesh`` into ``(data, tp)`` degrees (``""`` -> (1, 1));
+        see :func:`parse_serve_mesh`."""
+        return parse_serve_mesh(self.mesh)
+
+    @property
+    def mesh_devices(self) -> int:
+        """Total devices the mesh spec asks for (1 = unsharded engine)."""
+        data, tp = self.mesh_axes()
+        return data * tp
 
     def max_blocks_per_seq(self, n_positions: int) -> int:
         """Static block-table width: enough blocks for a full-context
         sequence."""
         return -(-n_positions // self.block_size)
+
+
+def parse_serve_mesh(mesh: str) -> tuple[int, int]:
+    """Parse a serving mesh spec into ``(data, tp)`` degrees (``""`` ->
+    (1, 1)).
+
+    Accepts ``"data:N[,tp:M]"`` (bench/CLI form) and ``"data=N[,tp=M]"``
+    (parallel/mesh.py MeshSpec form). Self-contained on purpose: config.py
+    stays importable without jax or the parallel package, so CLIs
+    (``scripts/bench_serve.py``) can validate mesh flags at parse time.
+    """
+    degrees = {"data": 1, "tp": 1}
+    if not mesh:
+        return 1, 1
+    seen: set[str] = set()
+    for part in mesh.split(","):
+        name, _, deg = part.replace("=", ":").partition(":")
+        name = name.strip()
+        if name not in degrees:
+            raise ValueError(
+                f"mesh={mesh!r}: unknown axis {name!r} (serving "
+                f"meshes use 'data' and 'tp' only)"
+            )
+        if name in seen:
+            raise ValueError(f"mesh={mesh!r}: duplicate axis {name!r}")
+        seen.add(name)
+        try:
+            n = int(deg.strip())
+        except ValueError:
+            raise ValueError(
+                f"mesh={mesh!r}: axis {name!r} needs an integer "
+                f"degree, got {deg.strip()!r}"
+            ) from None
+        if n < 1:
+            raise ValueError(
+                f"mesh={mesh!r}: axis {name!r} degree must be >= 1"
+            )
+        degrees[name] = n
+    return degrees["data"], degrees["tp"]
 
 
 # BASELINE.json configs 1-5 require these four sizes; the standard GPT-2 family.

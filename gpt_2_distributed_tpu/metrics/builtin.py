@@ -256,6 +256,9 @@ for _name, _dist in (
     ("requests_migrated", "sum"),      # cumulative requests moved off failed replicas
     ("requests_timed_out", "sum"),     # cumulative deadline evictions (504s)
     ("watchdog_trips", "sum"),         # cumulative step-watchdog firings
+    ("serve_mesh_devices", "max"),     # devices across the fleet's serving meshes
+    ("kv_pool_bytes_per_device", "max"),  # largest per-device KV pool footprint
+    ("prefill_batched", "sum"),        # cumulative extra rows batched into prefills
 ):
     METRIC_REGISTRY.metric(
         _name, reduction=ReductionStrategy.CURRENT, tb_prefix="serve/",

@@ -85,7 +85,7 @@ def prefill(
     def body(x, bp):
         y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
         q, k, v = gpt2.qkv_proj(config, y, bp)      # [B, P, H, D]
-        o = attn_fn(q, k, v, deterministic=True)
+        o = gpt2.gather_attn_heads(attn_fn(q, k, v, deterministic=True))
         o = o.reshape(b, p, config.n_embd)
         o = o @ bp["attn_proj_w"].astype(x.dtype) + bp["attn_proj_b"].astype(x.dtype)
         x = x + o

@@ -180,6 +180,34 @@ def qkv_proj(
     )
 
 
+def gather_attn_heads(o: jnp.ndarray, data_rows: bool = False) -> jnp.ndarray:
+    """All-gather the head axis of an attention output before the
+    out-projection when serving tensor parallelism is active; no-op
+    otherwise (single device, tp=1, or outside ``activate_mesh``).
+
+    The out-projection contracts over C = H*D. With H tp-sharded (the
+    serving mesh — ``parallel.sharding.serve_param_pspecs``) GSPMD would
+    compute per-shard partial products and psum them, re-associating the
+    accumulation and breaking the serving engine's bit-exactness contract.
+    Pinning ``o`` head-replicated first makes the shard boundary pure data
+    movement: the gather moves bits, and the contraction then runs the
+    single-device program on every device. ``data_rows`` keeps the leading
+    batch axis sharded over 'data' (the decode step's row placement) so the
+    gather is tp-only.
+    """
+    if not _tp_active():
+        return o
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gpt_2_distributed_tpu.parallel.mesh import DATA_AXIS, active_mesh
+
+    lead = DATA_AXIS if data_rows else None
+    spec = P(lead, *([None] * (o.ndim - 1)))
+    return jax.lax.with_sharding_constraint(
+        o, NamedSharding(active_mesh(), spec)
+    )
+
+
 def _attn_sublayer(
     config: GPT2Config,
     x: jnp.ndarray,  # [B, T, C] in compute dtype

@@ -28,8 +28,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from gpt_2_distributed_tpu.config import DEFAULT_BLOCK_ROWS  # noqa: F401 — canonical home is config (jax-free for CLIs); re-exported here for the op's callers
+
 IGNORE_INDEX = -100
-DEFAULT_BLOCK_ROWS = 1024
 
 
 def _chunk_logits(x_chunk, wte):

@@ -266,6 +266,23 @@ def paged_attention_pallas(
     return out[:, :, 0]
 
 
+def _serving_mesh_active() -> bool:
+    """True when tracing under a multi-device serving mesh (data or tp > 1)
+    activated via ``parallel.mesh.activate_mesh``."""
+    from gpt_2_distributed_tpu.parallel.mesh import (
+        DATA_AXIS,
+        TP_AXIS,
+        active_mesh,
+    )
+
+    m = active_mesh()
+    if m is None:
+        return False
+    return any(
+        ax in m.axis_names and m.shape[ax] > 1 for ax in (DATA_AXIS, TP_AXIS)
+    )
+
+
 def paged_attention(
     q: jnp.ndarray,            # [B, H, D]
     k_pool: jnp.ndarray,       # [N, H, bs, D]
@@ -291,6 +308,12 @@ def paged_attention(
         )
     if impl == "auto":
         impl = "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+        if impl == "pallas" and _serving_mesh_active():
+            # A sharded engine traces this under its data×tp mesh; the
+            # Pallas kernel can't consume GSPMD-sharded pools/tables, so
+            # "auto" degrades to the XLA gather (correct on any mesh).
+            # Forced "pallas" still goes through and fails loudly.
+            impl = "xla"
     if impl == "pallas":
         return paged_attention_pallas(
             q, k_pool, v_pool, block_table, lengths, interpret=interpret

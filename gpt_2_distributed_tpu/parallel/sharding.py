@@ -128,6 +128,44 @@ def param_pspecs(params: Any, mesh: Mesh) -> Any:
     return specs
 
 
+def serve_param_pspecs(params: Any, mesh: Mesh) -> Any:
+    """Param PartitionSpecs for the *serving* engine's tp axis.
+
+    Unlike :func:`param_pspecs`, only the head-axis qkv leaves
+    (``_TP_HEAD_LEAVES``) are sharded over 'tp'. The Megatron row/col
+    placements (``attn_proj_w``/``mlp_proj_w`` row-sharded, ``mlp_fc_*``
+    col-sharded) are deliberately EXCLUDED: they make GSPMD psum partial
+    matmul products over 'tp', which changes the fp32 accumulation order and
+    breaks the serving engine's bit-exactness contract (every stream
+    bit-identical to ``generate_cached(batch=1)`` for any mesh shape,
+    tests/test_serving_sharded.py). Head-sharding the qkv einsum keeps every
+    reduction (over C) local to a shard — GSPMD only *partitions* the head
+    axis, it never re-associates a sum — so outputs stay bit-identical while
+    the dominant qkv matmul and the paged-attention gather still split over
+    'tp'. 'fsdp'/'sp' are serving no-ops and stay unsharded.
+    """
+    tp_size = mesh.shape[TP_AXIS] if TP_AXIS in mesh.axis_names else 1
+
+    def leaf_spec(path: tuple, leaf: Any) -> P:
+        shape = np.shape(leaf)
+        if len(shape) == 0 or tp_size <= 1:
+            return P()
+        is_block = any(getattr(k, "key", None) == "block" for k in path)
+        leaf_name = next(
+            (getattr(k, "key", None) for k in reversed(path)
+             if getattr(k, "key", None)), None,
+        )
+        if is_block and leaf_name in _TP_HEAD_LEAVES:
+            head_dim = _TP_HEAD_LEAVES[leaf_name]
+            if shape[head_dim] % tp_size == 0:
+                spec: list = [None] * len(shape)
+                spec[head_dim] = TP_AXIS
+                return P(*spec)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
 def _leaf_update_pspec(
     path: tuple, leaf: Any, data_size: int, fsdp_size: int, tp_size: int = 1
 ) -> P:

@@ -53,6 +53,27 @@ is the serving-shaped alternative:
   resume never re-emits: its last sampled token is carried as the pending
   decode input, so TTFT reflects first emission, not re-admission.
 
+* **Multi-chip serving** (``ServeConfig.mesh``, e.g. ``"data:4"`` or
+  ``"data:2,tp:2"``): the engine builds a data×tp mesh
+  (``parallel/mesh.py``) and runs the SAME compiled programs sharded under
+  it — the KV pools split their block axis over 'data' and their head axis
+  over 'tp', the decode step's ``max_batch`` rows split over 'data', and
+  the qkv projections head-shard over 'tp'
+  (``parallel.sharding.serve_param_pspecs``). Only reduction-preserving
+  dims are sharded (GSPMD partitions them without re-associating any fp32
+  sum), so streams stay bit-identical to the single-device engine for any
+  mesh shape. The scheduler stays host-side and host-global, but becomes
+  shard-aware: each data shard owns ``max_batch/data`` slot rows and
+  ``num_blocks/data`` pool blocks (``BlockAllocator`` per-shard free
+  lists), admission/watermark/grow/preempt account per shard, and
+  prefix-cache hits truncate at the first foreign-shard block.
+* **Batched multi-row prefill admission** (``ServeConfig.prefill_batch``):
+  in chunked mode, up to ``prefill_batch`` in-progress prefills advance in
+  ONE batched chunk dispatch per engine step (row count padded to
+  ``prefill_batch`` so the program still compiles once) — single-row
+  admission was the step-rate bottleneck once 'data' multiplied the
+  concurrent slots.
+
 Exactness contract: with ``attn_impl="xla"`` on CPU, each request's token
 stream is bit-identical to ``generate_cached(batch=1, prompt, rng=request
 key)`` — greedy AND seeded sampling — for ANY interleaving of other
@@ -70,6 +91,7 @@ enforces all of it.
 from __future__ import annotations
 
 import collections
+import contextlib
 import functools
 import time
 from typing import Callable, Sequence
@@ -95,6 +117,8 @@ from gpt_2_distributed_tpu.serving.paged_cache import (
     PrefixCache,
     copy_block,
     init_pools,
+    make_pool_jits,
+    pool_bytes,
     scatter_prefill,
 )
 
@@ -170,9 +194,9 @@ def _prefill_impl(
     prompt: jnp.ndarray,   # [1, Pf] int32, right-padded to the bucket
     p_real: jnp.ndarray,   # scalar int32 — true prompt length (traced!)
     key: jnp.ndarray,      # [2] uint32
+    pad_to: int,           # static (positional: pjit in_shardings bars kwargs)
     *,
     config: GPT2Config,
-    pad_to: int,
     temperature: float,
     top_k: int | None,
     compute_dtype,
@@ -214,71 +238,79 @@ def _chunk_prefill_impl(
     params,
     k_pool: jnp.ndarray,       # [L, N, H, bs, D] — donated
     v_pool: jnp.ndarray,
-    bt_row: jnp.ndarray,       # [M] int32 — this request's block-table row
-    chunk: jnp.ndarray,        # [1, C] int32 tokens, right-padded
-    start: jnp.ndarray,        # scalar int32 — work position of chunk[0, 0]
-    clen: jnp.ndarray,         # scalar int32 — real tokens in this chunk
-    key: jnp.ndarray,          # [2] uint32
+    bt: jnp.ndarray,           # [R, M] int32 — one block-table row per request
+    chunk: jnp.ndarray,        # [R, C] int32 tokens, right-padded per row
+    start: jnp.ndarray,        # [R] int32 — work position of chunk[r, 0]
+    clen: jnp.ndarray,         # [R] int32 — real tokens per row (0 = pad row)
+    keys: jnp.ndarray,         # [R, 2] uint32 per-row PRNG chains
     *,
     config: GPT2Config,
     temperature: float,
     top_k: int | None,
 ):
-    """One prefill chunk straight into the pool: compute K/V for positions
-    ``[start, start + clen)``, scatter them into the request's blocks at
-    position granularity, attend over the partially-built table.
+    """R prefill chunks straight into the pool in one dispatch: compute
+    each row's K/V for positions ``[start_r, start_r + clen_r)``, scatter
+    them into that request's blocks at position granularity, attend over
+    the partially-built tables.
 
-    Compiles once per chunk width C (shape-keyed) — in chunked mode C is
-    ``ServeConfig.prefill_chunk`` for every prompt, so one compile total.
-    The whole-prompt continuation path (``prefill_chunk=0``) buckets C to
-    a block multiple like ``_prefill_impl`` does for prefix-cache hits
-    (remainder bounded by the prompt), and uses the full table width
-    ``M * bs`` for preemption resumes (remainder grows with generation —
-    one program covers every resume length).
+    Compiles once per (R, C) (shape-keyed) — in chunked mode R is
+    ``ServeConfig.prefill_batch`` and C is ``ServeConfig.prefill_chunk``
+    for every dispatch, so one compile total (short rounds pad with
+    ``clen=0`` rows). The whole-prompt continuation path
+    (``prefill_chunk=0``) runs R=1 and buckets C to a block multiple like
+    ``_prefill_impl`` does for prefix-cache hits (remainder bounded by the
+    prompt), and uses the full table width ``M * bs`` for preemption
+    resumes (remainder grows with generation — one program covers every
+    resume length).
 
     Bit-parity: every op mirrors the dense prefill path
     (``decode.prefill`` → ``causal_attention_bthd``) per position —
     identical embedding gathers, sublayer math, einsum forms, masked fp32
-    softmax — so for the dense-prefill configurations (the exactness
-    contract's scope) any chunk split reproduces whole-prompt prefill
-    bit-for-bit. Padded rows (``i >= clen``) are dropped from the scatter
-    (out-of-range destination) and causally masked out of every row we
-    read. Every chunk samples a token with the request key — one compiled
-    program — and the host discards it on non-final chunks, leaving the
-    PRNG chain's one split exactly where ``generate_cached`` puts it.
+    softmax — and rows are independent in every op (per-row gathers,
+    per-row attention via ``paged_prefill_attention``'s batch axis,
+    per-row PRNG chains in the vmapped sampler), so any chunk split AND
+    any row batching reproduces whole-prompt prefill bit-for-bit. Padded
+    positions (``i >= clen_r``) are dropped from the scatter (out-of-range
+    destination) and causally masked out of every row we read; an all-pad
+    row (``clen_r = 0``) scatters nothing and its sampled token/advanced
+    key are discarded by the host. Every row samples a token with its
+    request key — one compiled program — and the host discards it on
+    non-final chunks, leaving the PRNG chain's one split exactly where
+    ``generate_cached`` puts it.
 
-    Returns (sampled token at position start+clen-1, advanced key, pools).
+    Returns ([R] sampled tokens at each row's start+clen-1, advanced
+    [R, 2] keys, pools).
     """
-    c = chunk.shape[1]
+    r, c = chunk.shape
     n = k_pool.shape[1]
     bs = k_pool.shape[3]
+    m = bt.shape[1]
     dtype = k_pool.dtype
     start = jnp.asarray(start, jnp.int32)
     clen = jnp.asarray(clen, jnp.int32)
 
-    tok = params["wte"].astype(dtype).at[chunk].get(mode="clip")  # [1, C, E]
-    pos_ids = start + jax.lax.iota(jnp.int32, c)                  # [C]
+    tok = params["wte"].astype(dtype).at[chunk].get(mode="clip")  # [R, C, E]
+    pos_ids = start[:, None] + jax.lax.iota(jnp.int32, c)[None]   # [R, C]
     # Gather (not dynamic_slice): a straddling final chunk has pos_ids past
     # n_positions-1 on its padded rows; clip freezes THOSE rows only, where
     # dynamic_slice would clamp the start and shift every real position.
-    wpe = params["wpe"].astype(dtype).at[pos_ids].get(mode="clip")  # [C, E]
-    x = tok + wpe[None]
+    wpe = params["wpe"].astype(dtype).at[pos_ids].get(mode="clip")  # [R, C, E]
+    x = tok + wpe
 
-    valid = jax.lax.iota(jnp.int32, c) < clen                     # [C]
-    blk = bt_row.at[pos_ids // bs].get(mode="clip")
+    valid = jax.lax.iota(jnp.int32, c)[None] < clen[:, None]      # [R, C]
+    blk = jnp.take_along_axis(bt, jnp.minimum(pos_ids // bs, m - 1), axis=1)
     blk = jnp.where(valid, blk, n)   # out-of-range => scatter drops the row
     off = pos_ids % bs
 
     def body(x, layer):
         bp, kp, vp = layer           # kp/vp: [N, H, bs, D]
         y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
-        q, k, v = gpt2.qkv_proj(config, y, bp)                    # [1, C, H, D]
-        kp = kp.at[blk, :, off].set(k[0].astype(kp.dtype), mode="drop")
-        vp = vp.at[blk, :, off].set(v[0].astype(vp.dtype), mode="drop")
-        o = paged_prefill_attention(
-            q, kp, vp, bt_row[None], start[None]
-        )                                                          # [1, C, H, D]
-        o = o.reshape(1, c, config.n_embd)
+        q, k, v = gpt2.qkv_proj(config, y, bp)                    # [R, C, H, D]
+        kp = kp.at[blk, :, off].set(k.astype(kp.dtype), mode="drop")
+        vp = vp.at[blk, :, off].set(v.astype(vp.dtype), mode="drop")
+        o = paged_prefill_attention(q, kp, vp, bt, start)         # [R, C, H, D]
+        o = gpt2.gather_attn_heads(o)
+        o = o.reshape(r, c, config.n_embd)
         o = o @ bp["attn_proj_w"].astype(x.dtype) + bp["attn_proj_b"].astype(x.dtype)
         x = x + o
         x = gpt2._mlp_sublayer(config, x, bp, None, True)
@@ -286,14 +318,20 @@ def _chunk_prefill_impl(
 
     x, (kps, vps) = jax.lax.scan(body, x, (params["block"], k_pool, v_pool))
     x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
-    h_last = jax.lax.dynamic_slice_in_dim(x, clen - 1, 1, axis=1)[:, 0]
-    logits0 = jnp.einsum(
+    last = jnp.maximum(clen - 1, 0)                               # [R]
+    h_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    logits = jnp.einsum(
         "bc,vc->bv", h_last, params["wte"].astype(h_last.dtype),
         preferred_element_type=jnp.float32,
-    )
-    key, sub = jax.random.split(key)
-    first = sample_token(logits0, sub, temperature, top_k)[0]
-    return first, key, kps, vps
+    )                                                             # [R, V] fp32
+
+    def row_sample(logits_row, key):
+        key, sub = jax.random.split(key)
+        tok = sample_token(logits_row[None], sub, temperature, top_k)[0]
+        return tok, key
+
+    first, keys = jax.vmap(row_sample)(logits, keys)
+    return first.astype(jnp.int32), keys, kps, vps
 
 
 def _decode_step_impl(
@@ -344,6 +382,7 @@ def _decode_step_impl(
         o = paged_attention(
             q[:, 0], kp, vp, block_table, lengths, impl=attn_impl
         )                                                        # [B, H, D]
+        o = gpt2.gather_attn_heads(o, data_rows=True)
         o = o.reshape(bsz, 1, c)
         o = o @ bp["attn_proj_w"].astype(x.dtype) + bp["attn_proj_b"].astype(x.dtype)
         x = x + o
@@ -404,8 +443,82 @@ class ServingEngine:
         self.compute_dtype = compute_dtype
 
         self._m = serve.max_blocks_per_seq(config.n_positions)
-        self.k_pool, self.v_pool = init_pools(config, serve, compute_dtype)
-        self.allocator = BlockAllocator(serve.num_blocks)
+        # --- serving mesh (ServeConfig.mesh): data × tp, or None -----------
+        self._dp, self._tp = serve.mesh_axes()
+        self.mesh = None
+        self._pool_sharding = None
+        self._scatter_fn, self._copy_fn = scatter_prefill, copy_block
+        pool_sharding = None
+        decode_kw: dict = {}
+        chunk_kw: dict = {}
+        prefill_kw: dict = {}
+        if self._dp * self._tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from gpt_2_distributed_tpu.parallel.mesh import (
+                DATA_AXIS,
+                MeshSpec,
+                TP_AXIS,
+                create_mesh,
+            )
+            from gpt_2_distributed_tpu.parallel.sharding import (
+                serve_param_pspecs,
+            )
+
+            if jax.device_count() < self._dp * self._tp:
+                raise ValueError(
+                    f"mesh={serve.mesh!r} wants {self._dp * self._tp} "
+                    f"devices but only {jax.device_count()} are visible"
+                )
+            self.mesh = create_mesh(MeshSpec(data=self._dp, tp=self._tp))
+
+            def sh(*spec):
+                return NamedSharding(self.mesh, P(*spec))
+
+            # Pools: block axis over 'data' (each shard owns its run of
+            # blocks — matching the allocator's per-shard free lists), head
+            # axis over 'tp'.
+            pool_sharding = sh(None, DATA_AXIS, TP_AXIS, None, None)
+            self._pool_sharding = pool_sharding
+            # Params: tp head-shards the qkv leaves ONLY — the Megatron
+            # row/col placements would psum partial matmuls and break the
+            # bit-exactness contract (see serve_param_pspecs).
+            param_sh = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                serve_param_pspecs(self.params, self.mesh),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            self.params = jax.device_put(self.params, param_sh)
+            row_sh, vec_sh, rep_sh = sh(DATA_AXIS), sh(DATA_AXIS, None), sh()
+            # Explicit in/out shardings: jit commits the host numpy
+            # scheduler arrays straight to their row placements, and
+            # donation only elides the pool copy when the output sharding
+            # matches the (donated) input's — without the pin GSPMD may
+            # replicate outputs, silently un-sharding the engine.
+            decode_kw = dict(
+                in_shardings=(param_sh, pool_sharding, pool_sharding,
+                              vec_sh, row_sh, row_sh, row_sh, vec_sh),
+                out_shardings=(row_sh, vec_sh, pool_sharding, pool_sharding),
+            )
+            # Chunk-prefill rows are replicated over 'data' (R is small and
+            # unconstrained by the mesh; the matmuls still shard over 'tp'
+            # and the pool scatter lands data-sharded).
+            chunk_kw = dict(
+                in_shardings=(param_sh, pool_sharding, pool_sharding,
+                              rep_sh, rep_sh, rep_sh, rep_sh, rep_sh),
+                out_shardings=(rep_sh, rep_sh, pool_sharding, pool_sharding),
+            )
+            kv_sh = sh(None, TP_AXIS, None, None)
+            prefill_kw = dict(
+                in_shardings=(param_sh, rep_sh, rep_sh, rep_sh),
+                out_shardings=(rep_sh, rep_sh, kv_sh, kv_sh),
+            )
+            self._scatter_fn, self._copy_fn = make_pool_jits(pool_sharding)
+        self.k_pool, self.v_pool = init_pools(
+            config, serve, compute_dtype, sharding=pool_sharding
+        )
+        self.allocator = BlockAllocator(serve.num_blocks, num_shards=self._dp)
+        self._slots_per_shard = serve.max_batch // self._dp
         self._cache = PrefixCache(serve.block_size) if serve.prefix_cache else None
         # Scheduler state lives on the HOST as numpy: admission/eviction
         # mutate it in place for free, and the arrays ship to the compiled
@@ -426,6 +539,7 @@ class ServingEngine:
         self._deadlines = False   # any live request carries a deadline
         self.stats = {
             "admitted": 0, "finished": 0, "prefills": 0, "prefill_chunks": 0,
+            "prefill_dispatches": 0, "prefill_batched": 0,
             "decode_steps": 0, "tokens_out": 0,
             "preemptions": 0, "resumes": 0, "timeouts": 0,
             "prefix_hit_tokens": 0, "cow_copies": 0,
@@ -443,6 +557,7 @@ class ServingEngine:
                 attn_impl=serve.attn_impl,
             ),
             donate_argnames=("k_pool", "v_pool"),
+            **decode_kw,
         )
         self._prefill_fn = jax.jit(
             functools.partial(
@@ -450,7 +565,8 @@ class ServingEngine:
                 temperature=self.temperature, top_k=top_k,
                 compute_dtype=compute_dtype,
             ),
-            static_argnames=("pad_to",),
+            static_argnums=(4,),   # pad_to
+            **prefill_kw,
         )
         self._chunk_fn = jax.jit(
             functools.partial(
@@ -458,7 +574,35 @@ class ServingEngine:
                 temperature=self.temperature, top_k=top_k,
             ),
             donate_argnames=("k_pool", "v_pool"),
+            **chunk_kw,
         )
+        get_tracer().event(
+            "engine_mesh", mesh=serve.mesh or "single",
+            devices=self._dp * self._tp, data=self._dp, tp=self._tp,
+        )
+
+    def _mesh_scope(self):
+        """Context every device dispatch runs under: activates the serving
+        mesh so trace-time mesh discovery (``gpt2.qkv_proj``'s tp branch,
+        ``paged_attention``'s auto→xla degrade) sees it. Free no-op on the
+        single-device engine."""
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from gpt_2_distributed_tpu.parallel.mesh import activate_mesh
+
+        return activate_mesh(self.mesh)
+
+    def _slot_shard(self, slot: int) -> int:
+        """Data shard owning decode slot ``slot`` (0 on a 1-device engine)."""
+        return slot // self._slots_per_shard
+
+    @property
+    def kv_pool_bytes_per_device(self) -> int:
+        """Per-device bytes of the two KV pools under the serving mesh
+        ('data' splits the block axis, 'tp' the head axis)."""
+        return pool_bytes(
+            self.config, self.serve, jnp.dtype(self.compute_dtype).itemsize
+        ) // (self._dp * self._tp)
 
     # ------------------------------------------------------------- intake
 
@@ -498,12 +642,16 @@ class ServingEngine:
             self.config, len(prompt), max_new_tokens, self.top_k, batch=1
         )
         need = self._blocks_needed(len(prompt), max_new_tokens)
-        if need > self.serve.num_blocks - 1:
+        # A request must fit in the SMALLEST data shard (shard 0 also hosts
+        # the null block) so admission can always place the queue head once
+        # the engine drains; dp=1 reduces to the whole-pool check.
+        usable = self.serve.num_blocks // self._dp - 1
+        if need > usable:
             raise ValueError(
-                f"request needs {need} KV blocks but the pool only has "
-                f"{self.serve.num_blocks - 1} allocatable (num_blocks="
-                f"{self.serve.num_blocks}, block_size={self.serve.block_size})"
-                f" — it could never be admitted"
+                f"request needs {need} KV blocks but each data shard only "
+                f"has {usable} allocatable (num_blocks="
+                f"{self.serve.num_blocks}, block_size={self.serve.block_size}"
+                f", data={self._dp}) — it could never be admitted"
             )
         if isinstance(rng, int):
             rng = jax.random.PRNGKey(rng)
@@ -526,13 +674,16 @@ class ServingEngine:
         )
         return req
 
-    def _alloc_blocks(self, n: int, floor: int) -> list[int] | None:
-        """n blocks while leaving `floor` free, evicting unpinned
-        prefix-cache entries (LRU) under pressure."""
+    def _alloc_blocks(self, n: int, floor: int, shard: int = 0) -> list[int] | None:
+        """n blocks from one data shard's free list while leaving `floor`
+        of that shard free, evicting unpinned prefix-cache entries (LRU,
+        restricted to that shard's blocks) under pressure."""
         while True:
-            if self.allocator.available >= n + floor:
-                return self.allocator.alloc(n) if n else []
-            if self._cache is None or not self._cache.evict_one(self.allocator):
+            if self.allocator.available_in(shard) >= n + floor:
+                return self.allocator.alloc(n, shard) if n else []
+            if self._cache is None or not self._cache.evict_one(
+                self.allocator, shard
+            ):
                 return None
 
     def _admit_one(self, slot: int, req: RequestHandle) -> bool:
@@ -541,6 +692,7 @@ class ServingEngine:
         aligned-cached tail, then prefill (inline for whole-prompt mode,
         deferred to ``_prefill_tick`` for chunked mode)."""
         bs = self.serve.block_size
+        shard = self._slot_shard(slot)
         resuming = req._pending_token is not None
         work = np.asarray(
             req.prompt + (req.generated[:-1] if req.generated else []),
@@ -554,6 +706,18 @@ class ServingEngine:
         s0 = 0
         if self._cache is not None:
             hits = self._cache.lookup(work)
+            if self._dp > 1:
+                # A slot's table only references blocks its own data shard
+                # owns (admission capacity, watermark floors and
+                # grow/preempt all account per shard) — truncate the hit
+                # run at the first foreign-shard block. The run stays a
+                # valid prefix: K/V bits are placement-independent.
+                keep = 0
+                for b in hits:
+                    if self.allocator.shard_of(b) != shard:
+                        break
+                    keep += 1
+                del hits[keep:]
             if hits and len(hits) * bs == p_work:
                 # Whole prompt cached and block-aligned: the final block
                 # must be private (position p_work-1 is recomputed for its
@@ -575,11 +739,14 @@ class ServingEngine:
         if self.serve.admission == "watermark":
             now_blocks = min(-(-(p_work + 1) // bs), need_total)
             n_alloc = now_blocks - n_shared
-            floor = self.serve.watermark_blocks if self._has_active() else 0
+            floor = (
+                self.serve.watermark_blocks
+                if self._has_active_in(shard) else 0
+            )
         else:
             n_alloc = need_total - n_shared
             floor = 0
-        ids = self._alloc_blocks(max(n_alloc, 0), floor)
+        ids = self._alloc_blocks(max(n_alloc, 0), floor, shard)
         if ids is None:
             for b in shared:        # unwind the pins; head waits its turn
                 self.allocator.release([b])
@@ -589,9 +756,10 @@ class ServingEngine:
 
         if cow_src is not None:
             dst = ids[0]            # block index n_shared — the prompt tail
-            self.k_pool, self.v_pool = copy_block(
-                self.k_pool, self.v_pool, np.int32(cow_src), np.int32(dst)
-            )
+            with self._mesh_scope():
+                self.k_pool, self.v_pool = self._copy_fn(
+                    self.k_pool, self.v_pool, np.int32(cow_src), np.int32(dst)
+                )
             self.allocator.release([cow_src])   # drop the copy-window pin
             self.stats["cow_copies"] += 1
             get_tracer().event("cow", rid=req.id, src=cow_src, dst=dst)
@@ -637,18 +805,31 @@ class ServingEngine:
         return True
 
     def _try_admit(self) -> int:
-        """Admit queued requests into free slots, FIFO, while blocks last."""
+        """Admit queued requests into free slots, FIFO, while blocks last.
+
+        Sharded engine: a slot's shard fixes which block pool run the
+        request lands in, so the head gets one placement attempt PER data
+        shard (first free slot of each) before it blocks the queue —
+        shard 1 may have room when shard 0 is full. dp=1 reduces to the
+        old first-free-slot behavior exactly."""
         admitted = 0
         while self._queue:
-            slot = next(
-                (i for i, s in enumerate(self._slots) if s is None), None
-            )
-            if slot is None:
-                break
-            if not self._admit_one(slot, self._queue[0]):
+            placed = False
+            tried: set[int] = set()
+            for slot, s in enumerate(self._slots):
+                if s is not None:
+                    continue
+                shard = self._slot_shard(slot)
+                if shard in tried:
+                    continue
+                tried.add(shard)
+                if self._admit_one(slot, self._queue[0]):
+                    self._queue.popleft()
+                    admitted += 1
+                    placed = True
+                    break
+            if not placed:
                 break   # head waits for evictions; nothing jumps the queue
-            self._queue.popleft()
-            admitted += 1
         return admitted
 
     # ------------------------------------------------------------ prefill
@@ -665,18 +846,26 @@ class ServingEngine:
         pf = min(pb, self.config.n_positions)  # forward width
         prompt_arr = np.zeros((1, pf), np.int32)
         prompt_arr[0, :p] = req._work
+        tracer = get_tracer()
         t0 = time.monotonic()
-        first, key, k, v = self._prefill_fn(
-            self.params, prompt_arr, np.int32(p), req._key, pad_to=pb,
-        )
-        self.k_pool, self.v_pool = scatter_prefill(
-            self.k_pool, self.v_pool, k, v,
-            np.asarray(req._blocks[:nb], np.int32),
-        )
+        with self._mesh_scope():
+            first, key, k, v = self._prefill_fn(
+                self.params, prompt_arr, np.int32(p), req._key, pb,
+            )
+            scatter_span = (
+                tracer.span("shard_scatter", blocks=nb)
+                if self.mesh is not None else contextlib.nullcontext()
+            )
+            with scatter_span:
+                self.k_pool, self.v_pool = self._scatter_fn(
+                    self.k_pool, self.v_pool, k, v,
+                    np.asarray(req._blocks[:nb], np.int32),
+                )
         first.block_until_ready()
         dur_ms = (time.monotonic() - t0) * 1e3
         self.stats["prefill_ms"] += dur_ms
         self.stats["prefills"] += 1
+        self.stats["prefill_dispatches"] += 1
         get_tracer().event(
             "prefill_chunk", rid=req.id, n_tokens=p, dur_ms=dur_ms,
             whole=True,
@@ -686,12 +875,11 @@ class ServingEngine:
         return self._activate(slot, req, p, first, key)
 
     def _prefill_step(self, slot: int, req: RequestHandle) -> int:
-        """Advance one prefill chunk; on the final chunk, activate the
-        decode row. Returns tokens emitted (1 when a fresh request's first
-        token fires)."""
+        """Advance one prefill chunk for one request; on the final chunk,
+        activate the decode row. Returns tokens emitted (1 when a fresh
+        request's first token fires)."""
         s = req._prefill_pos
-        work = req._work
-        p_work = len(work)
+        p_work = len(req._work)
         if self.serve.prefill_chunk:
             width = self.serve.prefill_chunk
         elif req.generated:
@@ -705,31 +893,65 @@ class ServingEngine:
             # buckets the whole-prompt path compiles anyway.
             bs = self.serve.block_size
             width = min(-(-(p_work - s) // bs) * bs, self._m * bs)
-        cl = min(width, p_work - s)
-        chunk = np.zeros((1, width), np.int32)
-        chunk[0, :cl] = work[s:s + cl]
+        return self._prefill_rows([slot], width, 1)
+
+    def _prefill_rows(self, slots: list[int], width: int,
+                      pad_rows: int) -> int:
+        """Advance one prefill chunk for each slot in ``slots`` in ONE
+        batched dispatch (rows padded to ``pad_rows`` with ``clen=0`` so
+        the program's shape — and so its compile — is independent of how
+        many prefills happen to be in flight). Returns tokens emitted."""
+        r = max(pad_rows, len(slots))
+        bt = np.zeros((r, self._m), np.int32)
+        chunk = np.zeros((r, width), np.int32)
+        start = np.zeros((r,), np.int32)
+        clen = np.zeros((r,), np.int32)
+        keys = np.zeros((r, 2), np.uint32)
+        cls: list[int] = []
+        for i, slot in enumerate(slots):
+            req = self._slots[slot]
+            s = req._prefill_pos
+            cl = min(width, len(req._work) - s)
+            bt[i] = self.block_table[slot]
+            chunk[i, :cl] = req._work[s:s + cl]
+            start[i] = s
+            clen[i] = cl
+            keys[i] = req._key
+            cls.append(cl)
         t0 = time.monotonic()
-        first, key, self.k_pool, self.v_pool = self._chunk_fn(
-            self.params, self.k_pool, self.v_pool,
-            np.ascontiguousarray(self.block_table[slot]), chunk,
-            np.int32(s), np.int32(cl), req._key,
-        )
+        with self._mesh_scope():
+            first, out_keys, self.k_pool, self.v_pool = self._chunk_fn(
+                self.params, self.k_pool, self.v_pool,
+                bt, chunk, start, clen, keys,
+            )
         first.block_until_ready()
         dur_ms = (time.monotonic() - t0) * 1e3
+        first_host = np.asarray(first)
+        keys_host = np.asarray(out_keys)
         self.stats["prefill_ms"] += dur_ms
-        self.stats["prefill_chunks"] += 1
-        get_tracer().event(
-            "prefill_chunk", rid=req.id, n_tokens=cl, dur_ms=dur_ms,
-            whole=False,
-        )
-        s += cl
-        if s < p_work:
-            req._prefill_pos = s
-            return 0
-        self.stats["prefills"] += 1
-        req._prefill_pos = None
-        self._register_prefix(req)
-        return self._activate(slot, req, p_work, first, key)
+        self.stats["prefill_dispatches"] += 1
+        self.stats["prefill_batched"] += max(len(slots) - 1, 0)
+        tracer = get_tracer()
+        emitted = 0
+        for i, slot in enumerate(slots):
+            req = self._slots[slot]
+            cl = cls[i]
+            self.stats["prefill_chunks"] += 1
+            tracer.event(
+                "prefill_chunk", rid=req.id, n_tokens=cl, dur_ms=dur_ms,
+                whole=False,
+            )
+            s = req._prefill_pos + cl
+            if s < len(req._work):
+                req._prefill_pos = s
+                continue
+            self.stats["prefills"] += 1
+            req._prefill_pos = None
+            self._register_prefix(req)
+            emitted += self._activate(
+                slot, req, len(req._work), first_host[i], keys_host[i]
+            )
+        return emitted
 
     def _activate(self, slot: int, req: RequestHandle, p_work: int,
                   first, key) -> int:
@@ -778,21 +1000,26 @@ class ServingEngine:
             self._cache.insert(w, j, req._blocks[j], self.allocator)
 
     def _prefill_tick(self) -> int:
-        """Chunked mode: advance the OLDEST in-progress prefill by one
-        chunk per engine step — decode steps interleave between chunks,
-        which is the whole point."""
+        """Chunked mode: advance up to ``ServeConfig.prefill_batch``
+        in-progress prefills — oldest first — by one chunk each, in ONE
+        batched dispatch per engine step; decode steps interleave between
+        chunks, which is the whole point. Rows pad to ``prefill_batch`` so
+        the dispatch compiles once regardless of how many prefills are in
+        flight (``prefill_batch=1`` is exactly the old one-row tick)."""
         if self.serve.prefill_chunk == 0:
             return 0
-        cands = [
+        cands = sorted(
             (self._slots[s]._admit_order, s)
             for s in range(self.serve.max_batch)
             if self._slots[s] is not None
             and self._slots[s]._prefill_pos is not None
-        ]
+        )
         if not cands:
             return 0
-        _, slot = min(cands)
-        return self._prefill_step(slot, self._slots[slot])
+        slots = [s for _, s in cands[:self.serve.prefill_batch]]
+        return self._prefill_rows(
+            slots, self.serve.prefill_chunk, self.serve.prefill_batch
+        )
 
     # -------------------------------------------------------------- churn
 
@@ -929,15 +1156,20 @@ class ServingEngine:
             req = self._slots[slot]
             if req is None or not self.active[slot]:
                 continue    # preempted by an older row's growth below
+            shard = self._slot_shard(slot)
             while int(self.pos[slot]) // bs >= len(req._blocks):
-                ids = self._alloc_blocks(1, 0)
+                ids = self._alloc_blocks(1, 0, shard)
                 if ids is not None:
                     req._blocks.append(ids[0])
                     self.block_table[slot, len(req._blocks) - 1] = ids[0]
                     continue
+                # Preemption frees blocks on the starved SHARD — a foreign
+                # shard's newest request can't help (never empty: `slot`
+                # itself is a candidate).
                 victim = max(
                     (s for s in range(self.serve.max_batch)
-                     if self._slots[s] is not None),
+                     if self._slots[s] is not None
+                     and self._slot_shard(s) == shard),
                     key=lambda s: self._slots[s]._admit_order,
                 )
                 self._preempt(victim)
@@ -947,6 +1179,14 @@ class ServingEngine:
 
     def _has_active(self) -> bool:
         return any(s is not None for s in self._slots)
+
+    def _has_active_in(self, shard: int) -> bool:
+        """Any occupied slot on one data shard — the watermark floor is
+        per shard (each shard's pool run grows independently)."""
+        lo = shard * self._slots_per_shard
+        return any(
+            s is not None for s in self._slots[lo:lo + self._slots_per_shard]
+        )
 
     def has_work(self) -> bool:
         """Anything queued or in flight — the driver's step/skip gate."""
@@ -998,14 +1238,26 @@ class ServingEngine:
             "decode", rows=int(was_active.sum())
         ).__enter__()
         t0 = time.monotonic()
-        next_tokens, new_keys, self.k_pool, self.v_pool = self._decode_fn(
-            self.params, self.k_pool, self.v_pool, self.block_table,
-            self.tokens, self.pos, self.active, self.keys,
-        )
-        toks_host = np.asarray(next_tokens)
-        self.stats["decode_ms"] += (time.monotonic() - t0) * 1e3
-        self.stats["decode_steps"] += 1
-        decode_span.__exit__(None, None, None)
+        with self._mesh_scope():
+            next_tokens, new_keys, self.k_pool, self.v_pool = self._decode_fn(
+                self.params, self.k_pool, self.v_pool, self.block_table,
+                self.tokens, self.pos, self.active, self.keys,
+            )
+        if self.mesh is not None:
+            # Sharded engine: the dispatch returns async; fetching the
+            # row-sharded sampled tokens is the cross-shard all-gather the
+            # scheduler blocks on. Give it its own span (sibling of
+            # "decode") so step breakdowns show gather vs compute.
+            decode_span.__exit__(None, None, None)
+            with tracer.span("token_allgather", rows=int(was_active.sum())):
+                toks_host = np.asarray(next_tokens)
+            self.stats["decode_ms"] += (time.monotonic() - t0) * 1e3
+            self.stats["decode_steps"] += 1
+        else:
+            toks_host = np.asarray(next_tokens)
+            self.stats["decode_ms"] += (time.monotonic() - t0) * 1e3
+            self.stats["decode_steps"] += 1
+            decode_span.__exit__(None, None, None)
         self.keys = np.array(new_keys)  # writable copy: admission writes rows
         # Advance every row that decoded this step; evictions below then
         # reset their rows. Prefilling rows (occupied, inactive) hold still.
@@ -1059,6 +1311,9 @@ class ServingEngine:
             "serve_occupancy": float(
                 sum(s is not None for s in self._slots)
             ),
+            "serve_mesh_devices": float(self._dp * self._tp),
+            "kv_pool_bytes_per_device": float(self.kv_pool_bytes_per_device),
+            "prefill_batched": float(self.stats["prefill_batched"]),
         }
 
     def clear_prefix_cache(self) -> None:

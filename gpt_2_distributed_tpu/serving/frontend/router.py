@@ -150,8 +150,11 @@ class ReplicaRouter:
         for i, a in enumerate(self._active):
             if not a and not self._failed[i]:
                 self._active[i] = True
+                eng = self.engines[i]
                 get_tracer().event("scale_up", replica=i,
-                                   replicas=self.n_active)
+                                   replicas=self.n_active,
+                                   mesh=eng.serve.mesh or "single",
+                                   devices=eng.serve.mesh_devices)
                 return i
         if len(self.engines) >= self.max_replicas:
             return None
@@ -159,7 +162,10 @@ class ReplicaRouter:
         self._active.append(True)
         self._failed.append(False)
         i = len(self.engines) - 1
-        get_tracer().event("scale_up", replica=i, replicas=self.n_active)
+        eng = self.engines[i]
+        get_tracer().event("scale_up", replica=i, replicas=self.n_active,
+                           mesh=eng.serve.mesh or "single",
+                           devices=eng.serve.mesh_devices)
         return i
 
     def fail_replica(self, idx: int, reason: str = "step exception") -> int:
@@ -400,6 +406,15 @@ class ReplicaRouter:
             "requests_migrated": float(self.migrated),
             "requests_timed_out": float(
                 sum(e.stats["timeouts"] for e in self.engines)
+            ),
+            "serve_mesh_devices": float(
+                sum(e.serve.mesh_devices for e in self.engines)
+            ),
+            "kv_pool_bytes_per_device": float(
+                max(e.kv_pool_bytes_per_device for e in self.engines)
+            ),
+            "prefill_batched": float(
+                sum(e.stats["prefill_batched"] for e in self.engines)
             ),
         }
 

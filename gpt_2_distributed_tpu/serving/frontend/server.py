@@ -336,6 +336,14 @@ class FrontendServer:
         )
         out["failed_replicas"] = self.driver.router.n_failed
         out["watchdog_trips"] = self.driver.watchdog_trips
+        # Per-replica serving mesh: spec string + device count for each
+        # live engine (single-device replicas report "single" / 1).
+        out["replica_meshes"] = [
+            {"replica": i, "mesh": eng.serve.mesh or "single",
+             "devices": eng.serve.mesh_devices}
+            for i, eng in enumerate(self.driver.router.engines)
+            if eng is not None
+        ]
         scaler = self.driver.autoscaler
         if scaler is not None:
             out["autoscale"] = {"ticks": scaler.ticks,

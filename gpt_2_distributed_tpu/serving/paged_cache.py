@@ -39,31 +39,60 @@ class BlockAllocator:
     that wrote it still holds it — ``release`` decrements, and the block
     returns to the free list only at refcount zero. Double-free / foreign
     ids still fail loudly.
+
+    With ``num_shards > 1`` (sharded engine, ``ServeConfig.mesh``) the pool
+    splits into contiguous runs of ``num_blocks / num_shards`` blocks — run
+    ``s`` lives on data-shard ``s`` of the device mesh — and each shard keeps
+    its own free list. ``alloc(n, shard=s)`` then grants blocks from that
+    shard only, so a slot row's KV never straddles data shards (block ids
+    stay resolvable to one device without cross-shard gathers at decode).
+    Shard 0 also hosts the reserved null block, so it has one fewer usable
+    block than the others.
     """
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, num_shards: int = 1):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks={num_blocks} must be >= 2 (block 0 is reserved)"
             )
+        if num_shards < 1 or num_blocks % num_shards != 0:
+            raise ValueError(
+                f"num_shards={num_shards} must be >= 1 and divide "
+                f"num_blocks={num_blocks}"
+            )
         self.num_blocks = num_blocks
-        self._free: collections.deque[int] = collections.deque(
-            range(1, num_blocks)
-        )
+        self.num_shards = num_shards
+        self.blocks_per_shard = num_blocks // num_shards
+        self._free: list[collections.deque[int]] = [
+            collections.deque(
+                range(max(1, s * self.blocks_per_shard),
+                      (s + 1) * self.blocks_per_shard)
+            )
+            for s in range(num_shards)
+        ]
         self._held: dict[int, int] = {}
 
     @property
     def available(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free)
 
-    def alloc(self, n: int) -> list[int] | None:
-        """n blocks at refcount 1, or None (leaving the free list
-        untouched) if the pool can't currently cover them."""
+    def available_in(self, shard: int) -> int:
+        return len(self._free[shard])
+
+    def shard_of(self, i: int) -> int:
+        """Data shard owning pool block ``i``."""
+        return i // self.blocks_per_shard
+
+    def alloc(self, n: int, shard: int = 0) -> list[int] | None:
+        """n blocks at refcount 1 from one shard's free list, or None
+        (leaving the free list untouched) if that shard can't currently
+        cover them."""
         if n < 1:
             raise ValueError(f"alloc({n}): need at least one block")
-        if n > len(self._free):
+        free = self._free[shard]
+        if n > len(free):
             return None
-        ids = [self._free.popleft() for _ in range(n)]
+        ids = [free.popleft() for _ in range(n)]
         for i in ids:
             self._held[i] = 1
         return ids
@@ -92,7 +121,7 @@ class BlockAllocator:
             self._held[i] -= 1
             if self._held[i] == 0:
                 del self._held[i]
-                self._free.append(i)
+                self._free[self.shard_of(i)].append(i)
 
 
 class PrefixCache:
@@ -172,12 +201,17 @@ class PrefixCache:
         self._entries[key] = block_id
         return True
 
-    def evict_one(self, allocator: BlockAllocator) -> bool:
+    def evict_one(self, allocator: BlockAllocator, shard: int | None = None) -> bool:
         """Drop the LRU entry whose block no live request holds
-        (refcount 1 = cache-only). Returns False when every entry is
-        still pinned by an in-flight request."""
+        (refcount 1 = cache-only). ``shard`` restricts eviction to blocks
+        owned by that data shard (a sharded engine evicting to free shard-s
+        capacity gains nothing from releasing a foreign shard's block).
+        Returns False when every (matching) entry is still pinned by an
+        in-flight request."""
         for key, bid in self._entries.items():
-            if allocator.refcount(bid) == 1:
+            if allocator.refcount(bid) == 1 and (
+                shard is None or allocator.shard_of(bid) == shard
+            ):
                 del self._entries[key]
                 allocator.release([bid])
                 self.evictions += 1
@@ -194,8 +228,13 @@ def init_pools(
     config: GPT2Config,
     serve: ServeConfig,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    sharding=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """The preallocated K and V pools, ``[L, N, H, bs, D]`` zeros."""
+    """The preallocated K and V pools, ``[L, N, H, bs, D]`` zeros.
+
+    ``sharding`` (a NamedSharding; block axis over 'data', head axis over
+    'tp') places each pool directly on the serving mesh so no device ever
+    materializes the full buffer."""
     shape = (
         config.n_layer,
         serve.num_blocks,
@@ -203,6 +242,11 @@ def init_pools(
         serve.block_size,
         config.head_dim,
     )
+    if sharding is not None:
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, compute_dtype), out_shardings=sharding
+        )
+        return zeros(), zeros()
     return jnp.zeros(shape, compute_dtype), jnp.zeros(shape, compute_dtype)
 
 
@@ -214,21 +258,13 @@ def pool_bytes(config: GPT2Config, serve: ServeConfig, itemsize: int = 2) -> int
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def scatter_prefill(
+def _scatter_prefill_impl(
     k_pool: jnp.ndarray,   # [L, N, H, bs, D]
     v_pool: jnp.ndarray,
     k: jnp.ndarray,        # [L, H, Ppad, D] — prefill K, Ppad = nb * bs
     v: jnp.ndarray,
     block_ids: jnp.ndarray,  # [nb] int32 pool destinations
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Scatter one sequence's prefill K/V into its allocated pool blocks.
-
-    Compiles once per (Ppad, nb) bucket — the engine rounds prompt lengths
-    up to block multiples precisely so this signature set stays small. The
-    pools are donated: admission rewrites them in place rather than holding
-    two copies of the serving deployment's largest buffer.
-    """
     l, h, ppad, d = k.shape
     bs = k_pool.shape[3]
     nb = ppad // bs
@@ -240,22 +276,49 @@ def scatter_prefill(
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def copy_block(
+# Scatter one sequence's prefill K/V into its allocated pool blocks.
+#
+# Compiles once per (Ppad, nb) bucket — the engine rounds prompt lengths
+# up to block multiples precisely so this signature set stays small. The
+# pools are donated: admission rewrites them in place rather than holding
+# two copies of the serving deployment's largest buffer.
+scatter_prefill = functools.partial(
+    jax.jit, donate_argnums=(0, 1))(_scatter_prefill_impl)
+
+
+def _copy_block_impl(
     k_pool: jnp.ndarray,   # [L, N, H, bs, D]
     v_pool: jnp.ndarray,
     src: jnp.ndarray,      # scalar int32 source block
     dst: jnp.ndarray,      # scalar int32 destination block
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Copy-on-write: duplicate one pool block across all layers.
-
-    Used when a prompt ends exactly on a cached block boundary — the
-    request gets a private copy of the final cached block so its own
-    tail writes (the last prompt position is recomputed to produce the
-    first-token logits) can't corrupt the shared entry. src/dst are
-    traced, so this compiles once per pool shape.
-    """
     return (
         k_pool.at[:, dst].set(k_pool[:, src]),
         v_pool.at[:, dst].set(v_pool[:, src]),
+    )
+
+
+# Copy-on-write: duplicate one pool block across all layers.
+#
+# Used when a prompt ends exactly on a cached block boundary — the
+# request gets a private copy of the final cached block so its own
+# tail writes (the last prompt position is recomputed to produce the
+# first-token logits) can't corrupt the shared entry. src/dst are
+# traced, so this compiles once per pool shape.
+copy_block = functools.partial(
+    jax.jit, donate_argnums=(0, 1))(_copy_block_impl)
+
+
+def make_pool_jits(pool_sharding):
+    """Mesh-aware ``(scatter_prefill, copy_block)`` pair for a sharded
+    engine: same programs, jitted with explicit ``out_shardings`` pinning
+    the result pools to the input pools' placement — donation only elides
+    the copy when input and output shardings match, and without the pin
+    GSPMD is free to emit replicated outputs (silently un-sharding the
+    pool on the first admission). The module-level jits stay as-is for the
+    single-device engine and its tests."""
+    out = (pool_sharding, pool_sharding)
+    return (
+        jax.jit(_scatter_prefill_impl, donate_argnums=(0, 1), out_shardings=out),
+        jax.jit(_copy_block_impl, donate_argnums=(0, 1), out_shardings=out),
     )

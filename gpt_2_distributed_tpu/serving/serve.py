@@ -87,6 +87,14 @@ def add_engine_flags(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "xla", "pallas"])
     p.add_argument("--prefill_chunk", type=int, default=0,
                    help="prefill chunk width; 0 = whole-prompt prefill")
+    p.add_argument("--prefill_batch", type=int, default=1,
+                   help="chunked mode: in-progress prefills advanced per "
+                        "engine step, in ONE batched dispatch")
+    p.add_argument("--serve_mesh", default="",
+                   help="serving mesh spec 'data:N[,tp:M]' — shard the KV "
+                        "pool and decode rows over N data shards and the "
+                        "attention heads over M tp shards (streams stay "
+                        "bit-identical to single-device)")
     p.add_argument("--prefix_cache", action="store_true",
                    help="reuse KV blocks across shared prompt prefixes")
     p.add_argument("--admission", default="reserve",
@@ -250,17 +258,25 @@ def build_serve_config(args: argparse.Namespace, config):
     """ServeConfig from the shared engine flags (0 blocks = worst case)."""
     from gpt_2_distributed_tpu.config import ServeConfig
 
+    mesh = getattr(args, "serve_mesh", "") or ""
     num_blocks = args.num_blocks
     probe = ServeConfig(max_batch=args.max_batch, block_size=args.block_size)
     if num_blocks == 0:
         num_blocks = 1 + args.max_batch * probe.max_blocks_per_seq(
             config.n_positions
         )
+        if mesh:
+            # Sharded pool: the block count must split evenly over 'data'.
+            from gpt_2_distributed_tpu.config import parse_serve_mesh
+
+            data, _ = parse_serve_mesh(mesh)
+            num_blocks = -(-num_blocks // data) * data
     return ServeConfig(
         max_batch=args.max_batch, block_size=args.block_size,
         num_blocks=num_blocks, attn_impl=args.attn_impl, eos_id=args.eos,
         prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache,
         admission=args.admission, watermark_blocks=args.watermark_blocks,
+        mesh=mesh, prefill_batch=getattr(args, "prefill_batch", 1),
     )
 
 

@@ -36,6 +36,15 @@ same record discipline as scripts/bench_fused.py. ``--traces both`` (the
 committed-record mode) nests an ``original`` and a ``shared_prefix``
 section under ``"traces"``.
 
+``--serve_mesh data:N[,tp:M]`` runs the multi-chip comparison instead:
+the same seeded trace through a single-device engine and a mesh-sharded
+engine at matched per-device KV pool bytes (the sharded pool scales with
+the device count). The run asserts the token streams bit-identical and
+merges a ``sharded`` record — concurrent-slot capacity, per-device pool
+bytes, tok/s for both engines — into ``--json``. When fewer devices are
+visible than the mesh needs, the bench re-execs itself on the forced
+virtual-CPU-device platform the test suite uses.
+
 Usage (the committed-record invocation)::
 
     JAX_PLATFORMS=cpu python scripts/bench_serve.py --model 124M \
@@ -130,6 +139,17 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--admission", default="watermark",
                    choices=["reserve", "watermark"])
     p.add_argument("--watermark_blocks", type=int, default=3)
+    p.add_argument("--prefill_batch", type=int, default=1,
+                   help="queued prompts folded into ONE chunked-prefill "
+                   "dispatch per engine step (multi-row admission; only "
+                   "batches when --prefill_chunk > 0)")
+    p.add_argument("--serve_mesh", default="", metavar="data:N[,tp:M]",
+                   help="sharded mode: replay the seeded trace on a "
+                   "single-device engine AND a mesh-sharded engine at "
+                   "matched per-device KV pool bytes, assert the token "
+                   "streams bit-identical, and merge a 'sharded' record "
+                   "into --json. Re-execs itself with forced virtual host "
+                   "devices when too few are visible")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top_k", type=int, default=None)
     p.add_argument("--repeats", type=int, default=3,
@@ -237,6 +257,24 @@ def validate_args(p: argparse.ArgumentParser, args: argparse.Namespace) -> None:
         p.error(f"--watermark_blocks {args.watermark_blocks}: must be >= 0")
     if args.repeats < 1:
         p.error(f"--repeats {args.repeats}: need at least one measurement")
+    if args.prefill_batch < 1:
+        p.error(f"--prefill_batch {args.prefill_batch}: must be >= 1")
+    if args.serve_mesh:
+        # jax-free on purpose: config.py (and the package __init__ it
+        # pulls in) import no jax, so mesh specs are refused at parse time
+        # like every other unhonorable flag.
+        from gpt_2_distributed_tpu.config import parse_serve_mesh
+
+        try:
+            data, tp = parse_serve_mesh(args.serve_mesh)
+        except ValueError as e:
+            p.error(f"--serve_mesh: {e}")
+        if data * tp < 2:
+            p.error(f"--serve_mesh {args.serve_mesh!r}: the sharded "
+                    "comparison needs a mesh of >= 2 devices")
+        if args.duration > 0 or args.chaos or args.baseline_only:
+            p.error("--serve_mesh runs the closed-trace sharded "
+                    "comparison; drop --duration/--chaos/--baseline_only")
     if args.duration < 0:
         p.error(f"--duration {args.duration}: must be >= 0")
     if args.ramp is not None:
@@ -400,12 +438,14 @@ def run_engine(args, params, config, serve, trace, jax, np, make_engine):
         # 1-token write lands on the null block (block-table row of an
         # empty slot), which the engine already uses as the sanctioned
         # scribble target for idle decode rows.
-        _f, _, eng.k_pool, eng.v_pool = eng._chunk_fn(
-            eng.params, eng.k_pool, eng.v_pool,
-            np.ascontiguousarray(eng.block_table[0]),
-            np.zeros((1, eng._m * bs), np.int32), np.int32(0), np.int32(1),
-            jax.random.PRNGKey(0),
-        )
+        with eng._mesh_scope():
+            _f, _k, eng.k_pool, eng.v_pool = eng._chunk_fn(
+                eng.params, eng.k_pool, eng.v_pool,
+                np.ascontiguousarray(eng.block_table[:1]),
+                np.zeros((1, eng._m * bs), np.int32),
+                np.zeros((1,), np.int32), np.ones((1,), np.int32),
+                np.zeros((1, 2), np.uint32),
+            )
         _f.block_until_ready()
     keys = [jax.random.PRNGKey(args.trace_seed * 100_000 + i)
             for i in range(n)]
@@ -493,6 +533,76 @@ def run_engine(args, params, config, serve, trace, jax, np, make_engine):
             if rec["tok_s"] > best[0]["tok_s"]:
                 best = (rec, streams)
     return best
+
+
+def run_sharded(args, params, config, jax, np, make_engine):
+    """Same seeded trace through a single-device engine and a
+    ``--serve_mesh``-sharded engine at MATCHED per-device KV pool bytes:
+    the sharded pool and slot count scale with the mesh, so each chip
+    holds exactly the bytes it would hold serving alone. The sharded
+    engine must (a) stream every request bit-identically — the mesh is
+    invisible in tokens — and (b) offer ``data``× the concurrent decode
+    slots, which is the capacity multi-chip serving exists to buy."""
+    from gpt_2_distributed_tpu.config import ServeConfig, parse_serve_mesh
+    from gpt_2_distributed_tpu.serving.paged_cache import pool_bytes
+
+    dp, tp = parse_serve_mesh(args.serve_mesh)
+    base = dict(block_size=args.block_size, attn_impl=args.attn_impl,
+                prefill_chunk=args.prefill_chunk,
+                prefix_cache=args.prefix_cache == "on",
+                admission=args.admission,
+                watermark_blocks=args.watermark_blocks,
+                prefill_batch=args.prefill_batch)
+    probe = ServeConfig(max_batch=args.max_batch,
+                        block_size=args.block_size)
+    single_blocks = args.num_blocks or (
+        1 + args.max_batch * probe.max_blocks_per_seq(config.n_positions)
+    )
+    serve_single = ServeConfig(max_batch=args.max_batch,
+                               num_blocks=single_blocks, **base)
+    # data*tp times the pool over data*tp devices = the same bytes per
+    # device ('data' splits the block axis, 'tp' the head axis); data
+    # times the slot rows (block tables shard over 'data' only).
+    serve_sharded = ServeConfig(max_batch=args.max_batch * dp,
+                                num_blocks=single_blocks * dp * tp,
+                                mesh=args.serve_mesh, **base)
+    trace = make_trace(args, np, config.vocab_size,
+                       shared=args.traces != "original")
+    itemsize = 2  # bf16 pools
+    single_rec, single_streams = run_engine(
+        args, params, config, serve_single, trace, jax, np, make_engine
+    )
+    sharded_rec, sharded_streams = run_engine(
+        args, params, config, serve_sharded, trace, jax, np, make_engine
+    )
+    return {
+        "mesh": args.serve_mesh, "data": dp, "tp": tp, "devices": dp * tp,
+        "trace": trace[3],
+        "serve": {"block_size": args.block_size,
+                  "prefill_chunk": args.prefill_chunk,
+                  "prefill_batch": args.prefill_batch,
+                  "prefix_cache": args.prefix_cache == "on",
+                  "admission": args.admission},
+        "single": {
+            **single_rec,
+            "concurrent_slots": serve_single.max_batch,
+            "num_blocks": serve_single.num_blocks,
+            "kv_pool_bytes_per_device": pool_bytes(
+                config, serve_single, itemsize),
+        },
+        "sharded": {
+            **sharded_rec,
+            "concurrent_slots": serve_sharded.max_batch,
+            "num_blocks": serve_sharded.num_blocks,
+            "kv_pool_bytes_per_device": pool_bytes(
+                config, serve_sharded, itemsize) // (dp * tp),
+        },
+        "slot_capacity_ratio": round(
+            serve_sharded.max_batch / serve_single.max_batch, 2),
+        "sharded_tok_s_ratio": round(
+            sharded_rec["tok_s"] / single_rec["tok_s"], 2),
+        "streams_bit_identical": sharded_streams == single_streams,
+    }
 
 
 def run_frontend(args, config, serve, jax, np, make_engine, policy,
@@ -770,6 +880,41 @@ def main(argv=None) -> None:
     )
     from gpt_2_distributed_tpu.serving import ServingEngine
 
+    if args.serve_mesh:
+        from gpt_2_distributed_tpu.config import parse_serve_mesh
+
+        _dp, _tp = parse_serve_mesh(args.serve_mesh)
+        need = _dp * _tp
+        if (jax.device_count() < need
+                and os.environ.get("_BENCH_SERVE_FORCED") != "1"):
+            # Too few real devices: re-exec against the forced virtual
+            # CPU platform (the test suite's conftest pattern) so the
+            # sharded and single-device engines run in ONE process and
+            # the stream comparison is apples-to-apples. highest matmul
+            # precision pins both engines to the same fp32 reductions the
+            # parity tests use.
+            import re
+            import subprocess
+
+            env = dict(os.environ, _BENCH_SERVE_FORCED="1",
+                       JAX_PLATFORMS="cpu",
+                       JAX_DEFAULT_MATMUL_PRECISION="highest")
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                env.get("XLA_FLAGS", ""),
+            ).strip()
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={need}"
+            ).strip()
+            sys.exit(subprocess.call(
+                [sys.executable, os.path.abspath(__file__),
+                 *(argv if argv is not None else sys.argv[1:])], env=env,
+            ))
+        if jax.device_count() < need:
+            p.error(f"--serve_mesh {args.serve_mesh!r} needs {need} "
+                    f"devices; the forced re-exec still sees only "
+                    f"{jax.device_count()}")
+
     global _XLA_CAPTURE
     if args.trace_dir:
         configure_tracing(args.trace_dir)
@@ -809,6 +954,7 @@ def main(argv=None) -> None:
             **base, prefill_chunk=args.prefill_chunk,
             prefix_cache=args.prefix_cache == "on",
             admission=args.admission, watermark_blocks=args.watermark_blocks,
+            prefill_batch=args.prefill_batch,
         )
         return new, ServeConfig(**base)
 
@@ -817,6 +963,29 @@ def main(argv=None) -> None:
     def make_engine(serve):
         return ServingEngine(params, config, serve,
                              temperature=args.temperature, top_k=args.top_k)
+
+    if args.serve_mesh:
+        rec = run_sharded(args, params, config, jax, np, make_engine)
+        _XLA_CAPTURE.stop_if_active()
+        get_tracer().close()
+        if args.json:
+            out = {"bench": "serve",
+                   "device": jax.devices()[0].device_kind,
+                   "n_devices": jax.device_count(),
+                   "model": {"preset": args.model, **overrides}}
+            if os.path.exists(args.json):
+                with open(args.json) as f:
+                    out = json.load(f)
+            out["sharded"] = rec
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps({"sharded": rec}))
+        if not rec["streams_bit_identical"]:
+            sys.exit("sharded: token streams diverged between the single-"
+                     "device and mesh-sharded engines — sharding broke "
+                     "bit-exactness")
+        return
 
     if args.chaos and (args.fail_spec is None and args.hang_spec is None
                        and args.inject_step_exception is None):

@@ -260,6 +260,35 @@ def frontend_summary(serving: dict[str, Any] | None) -> dict[str, Any] | None:
     }
 
 
+def mesh_summary(records: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Serving mesh shape(s) in the trace. Every engine emits one
+    ``engine_mesh`` event at construction (mesh spec + data/tp degrees);
+    the router's ``scale_up`` events add the replica index. A fleet where
+    replicas disagree on mesh shape is worth seeing at a glance — capacity
+    math (tok/s per device, concurrent slots) differs per replica."""
+    engines = [
+        r["attrs"] for r in records
+        if r.get("ph") == "event" and r.get("name") == "engine_mesh"
+    ]
+    if not engines:
+        return None
+    per_replica: dict[str, str] = {}
+    for r in records:
+        if r.get("ph") == "event" and r.get("name") == "scale_up":
+            a = r.get("attrs", {})
+            if "mesh" in a:
+                per_replica[str(a.get("replica"))] = a["mesh"]
+    shapes: dict[str, int] = defaultdict(int)
+    for a in engines:
+        shapes[a.get("mesh", "single")] += 1
+    return {
+        "n_engines": len(engines),
+        "shapes": dict(sorted(shapes.items())),
+        "devices_per_engine": max(a.get("devices", 1) for a in engines),
+        "replica_meshes": dict(sorted(per_replica.items())) or None,
+    }
+
+
 def build_report(trace_dir: str) -> dict[str, Any]:
     records = load_trace_dir(trace_dir)
     serving = request_waterfall(records)
@@ -270,6 +299,7 @@ def build_report(trace_dir: str) -> dict[str, Any]:
         "engine_steps": step_breakdown(records, "engine_step"),
         "serving": serving,
         "frontend": frontend_summary(serving),
+        "meshes": mesh_summary(records),
     }
 
 
@@ -323,6 +353,16 @@ def _print_frontend(report: dict[str, Any], limit: int) -> None:
     print(f"  requests/replica: {fs['requests_per_replica']}  "
           f"routes by policy: {fs['routes_by_policy']}  "
           f"affinity share: {fs['affinity_share']:.0%}")
+    meshes = report.get("meshes")
+    if meshes:
+        shapes = ", ".join(f"{m}×{n}" if n > 1 else m
+                           for m, n in meshes["shapes"].items())
+        line = (f"  replica mesh: {shapes} "
+                f"({meshes['devices_per_engine']} device(s)/engine)")
+        if meshes["replica_meshes"] and len(set(
+                meshes["replica_meshes"].values())) > 1:
+            line += f"  per replica: {meshes['replica_meshes']}"
+        print(line)
     if fs["n_migrated"] or fs["n_timed_out"] or fs["n_failed"]:
         print(f"  fault tolerance: {fs['n_migrated']} migrated, "
               f"{fs['n_timed_out']} timed out, {fs['n_failed']} failed")

@@ -189,6 +189,11 @@ def test_update_call_sites_found():
     assert "requests_migrated" in names    # **router.metrics_snapshot()
     assert "requests_timed_out" in names   # **router.metrics_snapshot()
     assert "watchdog_trips" in names       # direct kwarg (driver.step/drain)
+    # PR 17 sharded-serving keys: present in BOTH snapshot dict literals
+    # (engine per-replica, router fleet aggregate)
+    assert "serve_mesh_devices" in names
+    assert "kv_pool_bytes_per_device" in names
+    assert "prefill_batched" in names
 
 
 def test_every_pushed_metric_is_registered():

@@ -24,6 +24,8 @@ import sys
 import numpy as np
 import pytest
 
+from conftest import forced_host_device_env
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO_ROOT, "tests", "_multihost_worker.py")
 
@@ -48,14 +50,16 @@ def _run_worker_pair(
     mid-run and never reach the JSON print.
     """
     port = _free_port()
-    env_base = {
-        **os.environ,
+    # 4 forced devices per rank -> the pair rebuilds the suite's 8-device
+    # global topology (the worker re-pins its own flags too, but routing the
+    # env through the shared conftest helper keeps the two suites' pattern
+    # identical).
+    env_base = forced_host_device_env(4, {
         "MASTER_ADDR": "127.0.0.1",
         "MASTER_PORT": str(port),
         "WORLD_SIZE": "2",
-        "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
         **(extra_env or {}),
-    }
+    })
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, phase],

@@ -386,3 +386,49 @@ def test_engine_default_run_writes_no_trace(tmp_path, tiny_params, tiny_config):
     eng.run_until_idle()
     assert h.done and len(h.generated) == 4
     assert list(tmp_path.iterdir()) == []
+
+
+def test_sharded_engine_trace_mesh_and_cross_shard_spans(
+    tmp_path, tiny_params, tiny_config
+):
+    """A mesh-sharded engine run leaves its shape in the trace: the
+    engine_mesh construction event (what obs_report's mesh_summary and the
+    --frontend mesh line read), a shard_scatter span per whole-prompt
+    prefill and a token_allgather span per decode step — the two
+    cross-shard transfers a capacity model has to price."""
+    from gpt_2_distributed_tpu.config import ServeConfig
+    from gpt_2_distributed_tpu.serving import ServingEngine
+    from scripts.obs_report import mesh_summary
+
+    get_tracer().configure(str(tmp_path))
+    eng = ServingEngine(
+        tiny_params, tiny_config,
+        ServeConfig(max_batch=2, block_size=8, num_blocks=32,
+                    attn_impl="xla", mesh="data:2"),
+        temperature=0.0,
+    )
+    hs = [eng.submit([1, 2, 3, 4, 5], 4, rng=0),
+          eng.submit([9, 8, 7], 4, rng=1)]
+    eng.run_until_idle()
+    get_tracer().configure(None, enabled=False)
+    assert all(h.done for h in hs)
+
+    records = load_trace_dir(str(tmp_path))
+    mesh_evs = [r for r in records
+                if r.get("ph") == "event" and r["name"] == "engine_mesh"]
+    assert len(mesh_evs) == 1
+    assert mesh_evs[0]["attrs"] == {
+        "mesh": "data:2", "devices": 2, "data": 2, "tp": 1,
+    }
+    spans = {r["name"] for r in records if r.get("ph") == "span"}
+    assert "shard_scatter" in spans     # one per whole-prompt prefill
+    assert "token_allgather" in spans   # one per decode step
+
+    ms = mesh_summary(records)
+    assert ms == {
+        "n_engines": 1,
+        "shapes": {"data:2": 1},
+        "devices_per_engine": 2,
+        "replica_meshes": None,   # single engine, no router scale_up
+    }
+    assert build_report(str(tmp_path))["meshes"] == ms

@@ -651,6 +651,11 @@ def test_bench_serve_rejects_unhonorable_flags(tmp_path):
         (("--prefill_chunk", "-1"), "--prefill_chunk"),
         (("--watermark_blocks", "-1"), "--watermark_blocks"),
         (("--repeats", "0"), "--repeats"),
+        (("--prefill_batch", "0"), "--prefill_batch"),
+        # mesh specs are validated jax-free via config.parse_serve_mesh
+        (("--serve_mesh", "fsdp:2"), "--serve_mesh"),
+        (("--serve_mesh", "data:1"), "--serve_mesh"),
+        (("--serve_mesh", "data:2", "--chaos"), "--serve_mesh"),
     ):
         r = _run_bench_serve(*flags, poison_jax_dir=poison)
         assert r.returncode != 0, flags
