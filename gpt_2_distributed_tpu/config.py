@@ -482,6 +482,50 @@ def parse_serve_mesh(mesh: str) -> tuple[int, int]:
     return degrees["data"], degrees["tp"]
 
 
+# Replica placement modes for the serving frontend: `inprocess` builds
+# every ServingEngine inside the frontend process (the default — zero RPC
+# overhead, shared fate); `subprocess` hosts one engine per worker process
+# behind the RPC supervision plane (process-level blast radius).
+PLACEMENTS = ("inprocess", "subprocess")
+
+
+def validate_worker_flags(p, args) -> None:
+    """Parse-time validation of the ``--placement``/``--worker_*`` flag
+    family, shared by serve.py, server.py and bench_serve.py. jax-free on
+    purpose (mirrors ``parse_serve_mesh``): a bad worker flag must be
+    rejected before any CLI pays the jax import."""
+    if args.placement not in PLACEMENTS:
+        p.error(
+            f"--placement must be one of {'|'.join(PLACEMENTS)}, "
+            f"got {args.placement!r}"
+        )
+    if args.worker_max_respawns < 0:
+        p.error(
+            f"--worker_max_respawns must be >= 0, "
+            f"got {args.worker_max_respawns}"
+        )
+    if args.worker_respawn_backoff_s < 0:
+        p.error(
+            f"--worker_respawn_backoff_s must be >= 0, "
+            f"got {args.worker_respawn_backoff_s}"
+        )
+    if args.worker_rpc_timeout_s <= 0:
+        p.error(
+            f"--worker_rpc_timeout_s must be > 0, "
+            f"got {args.worker_rpc_timeout_s}"
+        )
+    if args.worker_heartbeat_s <= 0:
+        p.error(
+            f"--worker_heartbeat_s must be > 0, "
+            f"got {args.worker_heartbeat_s}"
+        )
+    if args.worker_connect_timeout_s <= 0:
+        p.error(
+            f"--worker_connect_timeout_s must be > 0, "
+            f"got {args.worker_connect_timeout_s}"
+        )
+
+
 # BASELINE.json configs 1-5 require these four sizes; the standard GPT-2 family.
 MODEL_PRESETS: dict[str, GPT2Config] = {
     "124M": GPT2Config(n_layer=12, n_embd=768, n_head=12),

@@ -259,6 +259,7 @@ for _name, _dist in (
     ("serve_mesh_devices", "max"),     # devices across the fleet's serving meshes
     ("kv_pool_bytes_per_device", "max"),  # largest per-device KV pool footprint
     ("prefill_batched", "sum"),        # cumulative extra rows batched into prefills
+    ("worker_restarts", "sum"),        # cumulative replacement worker respawns
 ):
     METRIC_REGISTRY.metric(
         _name, reduction=ReductionStrategy.CURRENT, tb_prefix="serve/",

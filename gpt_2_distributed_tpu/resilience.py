@@ -47,6 +47,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import signal
 import threading
 import urllib.request
@@ -385,6 +386,39 @@ class PreemptionHandler:
         return self._flag
 
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def forced_host_device_env(n_devices: int, extra: dict | None = None) -> dict:
+    """Subprocess env pinned to exactly ``n_devices`` virtual CPU devices.
+
+    The force-before-jax-import dance (JAX_PLATFORMS=cpu, any pre-existing
+    forced count in XLA_FLAGS replaced, highest matmul precision, repo on
+    PYTHONPATH) packaged for child processes. Hoisted here from
+    ``tests/conftest.py`` so the serving worker spawner (process-isolated
+    replicas on a CPU host) and the test suite share one implementation —
+    the pattern can't drift between library and tests. jax-free on purpose:
+    the spawner builds worker envs before the frontend ever imports jax.
+    ``extra`` overlays additional vars last.
+    """
+    env = dict(os.environ)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"
+    env["PYTHONPATH"] = (
+        _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    if extra:
+        env.update(extra)
+    return env
+
+
 class InjectedFault(RuntimeError):
     """Raised by :class:`FaultInjector` at its configured trigger point."""
 
@@ -432,6 +466,12 @@ class FaultInjector:
       detect -> condemn -> migrate chain deterministically.
     * ``exception_at`` — replica-agnostic raise: the original
       fleet-killer at driver.py's step loop, now contained.
+    * ``kill_at`` + ``kill_fn`` — call ``kill_fn(replica)`` and return
+      WITHOUT raising: the "process killed from outside" scenario for
+      subprocess placement. The chaos bench passes a ``kill_fn`` that
+      SIGKILLs/SIGSTOPs the worker process; death then surfaces the way
+      it would in production — as a broken or timed-out RPC on the very
+      step the injector just allowed to proceed.
     """
 
     def __init__(
@@ -440,14 +480,19 @@ class FaultInjector:
         hang_at: tuple[int, int | None] | None = None,
         exception_at: int | None = None,
         hang_max_s: float = 120.0,
+        kill_at: tuple[int, int | None] | None = None,
+        kill_fn=None,
     ) -> None:
         self.fail_at = fail_at
         self.hang_at = hang_at
         self.exception_at = exception_at
         self.hang_max_s = float(hang_max_s)
+        self.kill_at = kill_at
+        self.kill_fn = kill_fn
         self.fail_fired = False
         self.hang_fired = False
         self.exception_fired = False
+        self.kill_fired = False
         self._release = threading.Event()
 
     @staticmethod
@@ -459,6 +504,13 @@ class FaultInjector:
         self._release.set()
 
     def tick(self, step: int, replica: int) -> None:
+        if (self.kill_at is not None and not self.kill_fired
+                and self.kill_fn is not None
+                and self._match(self.kill_at, step, replica)):
+            self.kill_fired = True
+            self.kill_fn(replica)
+            # No raise: the kill lands out-of-band and must be DETECTED
+            # (broken RPC, heartbeat loss), not politely reported.
         if (self.fail_at is not None and not self.fail_fired
                 and self._match(self.fail_at, step, replica)):
             self.fail_fired = True

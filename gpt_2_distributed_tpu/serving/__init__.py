@@ -17,7 +17,26 @@ The paged attention ops themselves live with the other kernels
 (``ops/paged_attention.py``).
 """
 
-from gpt_2_distributed_tpu.serving.engine import RequestHandle, ServingEngine
-from gpt_2_distributed_tpu.serving.paged_cache import BlockAllocator, PrefixCache
+# Lazy exports (PEP 562): the engine pulls in jax at import time, but the
+# worker RPC plane (`frontend/rpc.py`, `frontend/worker.py`'s CLI startup)
+# must be importable jax-free — the worker binds its socket BEFORE the jax
+# import, and the frontend validates placement flags before paying for it.
+_EXPORTS = {
+    "BlockAllocator": "paged_cache",
+    "PrefixCache": "paged_cache",
+    "RequestHandle": "engine",
+    "ServingEngine": "engine",
+}
 
-__all__ = ["BlockAllocator", "PrefixCache", "RequestHandle", "ServingEngine"]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"gpt_2_distributed_tpu.serving.{_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

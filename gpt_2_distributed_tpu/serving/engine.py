@@ -123,6 +123,12 @@ from gpt_2_distributed_tpu.serving.paged_cache import (
 )
 
 
+# Version tag of the serialized request form (`RequestHandle.to_wire`).
+# Bump on any field-semantics change; `from_wire` rejects unknown versions
+# so a stale worker can never adopt a payload it would misinterpret.
+REQUEST_WIRE_VERSION = 1
+
+
 class RequestHandle:
     """One submitted request: its prompt, its growing output, and the
     accounting the bench and the serving CLI read (timestamps, queue wait,
@@ -187,6 +193,64 @@ class RequestHandle:
             "finish", ts=self.finish_time, rid=self.id, reason=reason,
             n_generated=len(self.generated),
         )
+
+    def to_wire(self) -> dict:
+        """Serialize the exact migration state ``extract_inflight``
+        captures — generated tokens, PRNG chain head, pending decode
+        input — so a request can cross a process boundary and resume
+        bit-identically with zero re-emitted tokens. Timestamps are
+        CLOCK_MONOTONIC, which is machine-wide on Linux, so deadlines and
+        queue-wait accounting stay valid across processes on one host."""
+        return {
+            "v": REQUEST_WIRE_VERSION,
+            "rid": self.id,
+            "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "generated": list(self.generated),
+            "key": [int(k) for k in self._key]
+            if self._key is not None else None,
+            "pending_token": self._pending_token,
+            "deadline": self.deadline,
+            "submit_time": self.submit_time,
+            "first_token_time": self.first_token_time,
+            "queue_wait_ms": self.queue_wait_ms,
+            "preemptions": self.preemptions,
+            "resumes": self.resumes,
+            "prefix_cached_tokens": self.prefix_cached_tokens,
+        }
+
+    @classmethod
+    def from_wire(
+        cls,
+        d: dict,
+        on_token: Callable[["RequestHandle", int], None] | None = None,
+    ) -> "RequestHandle":
+        """Rebuild a handle from :meth:`to_wire` output. Raises
+        ValueError on an unknown version tag — adopting a payload whose
+        fields we might misread would silently corrupt a stream."""
+        v = d.get("v")
+        if v != REQUEST_WIRE_VERSION:
+            raise ValueError(
+                f"unknown request wire version {v!r} "
+                f"(this build speaks {REQUEST_WIRE_VERSION})"
+            )
+        req = cls(
+            int(d["rid"]), [int(t) for t in d["prompt"]],
+            int(d["max_new_tokens"]), on_token,
+        )
+        req.generated = [int(t) for t in d["generated"]]
+        if d["key"] is not None:
+            req._key = np.asarray(d["key"], np.uint32)
+        if d["pending_token"] is not None:
+            req._pending_token = int(d["pending_token"])
+        req.deadline = d["deadline"]
+        req.submit_time = d["submit_time"]
+        req.first_token_time = d["first_token_time"]
+        req.queue_wait_ms = float(d["queue_wait_ms"])
+        req.preemptions = int(d["preemptions"])
+        req.resumes = int(d["resumes"])
+        req.prefix_cached_tokens = int(d["prefix_cached_tokens"])
+        return req
 
 
 def _prefill_impl(
@@ -1127,6 +1191,20 @@ class ServingEngine:
         out.extend(self._queue)
         self._queue.clear()
         return out
+
+    def decode_keys(self) -> dict[int, list[int]]:
+        """Post-step PRNG chain heads for every decode-active slotted
+        request, keyed by rid. The worker RPC sends this after each step
+        so the frontend's request mirrors always hold the same chain head
+        ``extract_inflight`` would capture — a worker SIGKILLed between
+        steps migrates from the mirrors with zero re-emission. Requests
+        queued or mid-prefill are absent: their chain never advanced, the
+        mirror's last-known key is already the head."""
+        return {
+            req.id: [int(k) for k in self.keys[slot]]
+            for slot, req in enumerate(self._slots)
+            if req is not None and req._prefill_pos is None
+        }
 
     def adopt(self, req: RequestHandle) -> None:
         """Enqueue a request extracted from another replica. No

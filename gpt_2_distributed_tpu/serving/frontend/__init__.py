@@ -7,26 +7,35 @@ Layering (each importable alone; the server composes all of them)::
     driver.py     the ONE submit/step/drain loop (also used by serve.py)
     autoscale.py  grow/shrink decisions from queue-depth + SLO signals
     router.py     prefix-affinity routing + SLO-aware admission
+    worker.py     subprocess replica placement (WorkerHandle/WorkerSpawner)
+    rpc.py        length-prefixed JSON framing for the worker RPC plane
 """
 
-from gpt_2_distributed_tpu.serving.frontend.autoscale import Autoscaler
-from gpt_2_distributed_tpu.serving.frontend.driver import (
-    DrainingError,
-    EngineDriver,
-    StepWatchdog,
-)
-from gpt_2_distributed_tpu.serving.frontend.router import (
-    ROUTE_POLICIES,
-    ReplicaRouter,
-    ShedError,
-)
+# Lazy exports (PEP 562): driver/router import the engine (jax); rpc and
+# worker stay importable jax-free so the worker CLI can bind its socket
+# before the jax import and the CLIs can validate flags before paying it.
+_EXPORTS = {
+    "Autoscaler": "autoscale",
+    "DrainingError": "driver",
+    "EngineDriver": "driver",
+    "StepWatchdog": "driver",
+    "ROUTE_POLICIES": "router",
+    "ReplicaRouter": "router",
+    "ShedError": "router",
+    "WireError": "rpc",
+    "WorkerHandle": "worker",
+    "WorkerSpawner": "worker",
+}
 
-__all__ = [
-    "Autoscaler",
-    "DrainingError",
-    "EngineDriver",
-    "ROUTE_POLICIES",
-    "ReplicaRouter",
-    "ShedError",
-    "StepWatchdog",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"gpt_2_distributed_tpu.serving.frontend.{_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -127,7 +127,23 @@ class Autoscaler:
             # Failure dropped the fleet below its floor: replace NOW,
             # bypassing streaks and cooldown — waiting out hysteresis to
             # restore promised capacity only prolongs the degradation.
-            if self.router.grow() is not None:
+            try:
+                grown = self.router.grow()
+            except RuntimeError as e:
+                # Subprocess placement: the worker spawner's respawn
+                # budget is exhausted. Degrade loudly and take a cooldown
+                # so the refusal is not retried every tick.
+                import sys
+
+                print(f"[autoscale] replacement failed: {e}",
+                      file=sys.stderr, flush=True)
+                get_tracer().event(
+                    "autoscale", action="replace_failed",
+                    replicas=self.router.n_active,
+                )
+                self._cooldown_left = self.cooldown
+                grown = None
+            if grown is not None:
                 self.scale_ups += 1
                 self.replacements += 1
                 self._cooldown_left = self.cooldown
@@ -144,7 +160,18 @@ class Autoscaler:
             self._shrink_streak = 0
             if (self._grow_streak >= self.grow_after
                     and self.router.n_active < self.max_replicas):
-                self.router.grow()
+                try:
+                    self.router.grow()
+                except RuntimeError as e:
+                    # Spawner respawn budget exhausted (growth past a
+                    # failed fleet counts as replacement): stay degraded.
+                    import sys
+
+                    print(f"[autoscale] grow failed: {e}",
+                          file=sys.stderr, flush=True)
+                    self._grow_streak = 0
+                    self._cooldown_left = self.cooldown
+                    return None
                 self.scale_ups += 1
                 self._grow_streak = 0
                 self._cooldown_left = self.cooldown

@@ -38,10 +38,14 @@ import concurrent.futures
 import sys
 import threading
 import time
-from typing import Callable, Sequence
+from typing import TYPE_CHECKING, Callable, Sequence
 
 from gpt_2_distributed_tpu.obs.trace import get_tracer
-from gpt_2_distributed_tpu.serving.engine import RequestHandle
+
+if TYPE_CHECKING:   # annotation-only: keeps this module importable
+    from gpt_2_distributed_tpu.serving.engine import (  # pragma: no cover
+        RequestHandle,
+    )  # without paying the jax import (the worker CLI contract)
 from gpt_2_distributed_tpu.serving.frontend.router import (
     ReplicaRouter,
     ShedError,
@@ -296,6 +300,26 @@ class EngineDriver:
         if self.injector is not None:
             self.injector.release_hangs()
 
+    def _check_worker_health(self) -> bool:
+        """Out-of-band liveness sweep for process-isolated replicas: a
+        worker that died BETWEEN steps (SIGKILL while idle, crash during
+        someone else's step) or stopped answering heartbeats is contained
+        here instead of waiting for traffic to trip over the corpse.
+        Duck-typed — in-process engines have no ``check_health`` and cost
+        one getattr per replica. Returns whether any replica failed."""
+        failed = False
+        for idx, eng in enumerate(self.router.engines):
+            if idx in self.router.failed_indices():
+                continue
+            probe = getattr(eng, "check_health", None)
+            if probe is None:
+                continue
+            reason = probe()
+            if reason is not None:
+                self._fail_replica(idx, reason)
+                failed = True
+        return failed
+
     def _fail_replica(self, idx: int, reason: str) -> None:
         """Containment: eject replica ``idx`` from the fleet, migrate its
         in-flight requests to healthy replicas, keep the loop running."""
@@ -323,6 +347,7 @@ class EngineDriver:
         in-flight stream on every replica."""
         self._check_preemption()
         self._consume_inbox()
+        self._check_worker_health()
         self.steps += 1
         if self.xla_capture is not None:
             self.xla_capture.maybe_start(self.steps)
@@ -396,6 +421,12 @@ class EngineDriver:
             self._check_preemption()
             if self.draining or self._stop:
                 break
+            # An idle fleet still supervises its workers: a replica that
+            # dies with no traffic must be replaced BEFORE the next burst,
+            # so a detected failure also ticks the autoscaler (below-min
+            # replacement) without waiting for a step.
+            if self._check_worker_health() and self.autoscaler is not None:
+                self.autoscaler.tick()
             self._wake.wait(idle_wait)
             self._wake.clear()
         # Drain whatever raced in while breaking out.
@@ -406,10 +437,15 @@ class EngineDriver:
         self.close()
 
     def close(self) -> None:
-        """Stop the step watchdog thread (idempotent). ``run_forever``
-        calls it on exit; the JSONL path calls it after its final drain."""
+        """Stop the step watchdog thread and shut down any worker
+        processes (idempotent). ``run_forever`` calls it on exit; the
+        JSONL path calls it after its final drain."""
         if self._watchdog is not None:
             self._watchdog.stop()
+        for eng in self.router.engines:
+            closer = getattr(eng, "close", None)
+            if closer is not None:
+                closer()
 
     def stop(self) -> None:
         """Ask ``run_forever`` to exit once idle (tests, clean shutdown)."""

@@ -47,10 +47,15 @@ the fleet inherits it unchanged. ``tests/test_frontend.py`` asserts it.
 from __future__ import annotations
 
 import time
-from typing import Callable, Sequence
+from typing import TYPE_CHECKING, Callable, Sequence
 
 from gpt_2_distributed_tpu.obs.trace import get_tracer
-from gpt_2_distributed_tpu.serving.engine import RequestHandle, ServingEngine
+
+if TYPE_CHECKING:   # annotation-only: keeps this module importable
+    from gpt_2_distributed_tpu.serving.engine import (  # pragma: no cover
+        RequestHandle,
+        ServingEngine,
+    )  # without paying the jax import (the worker CLI contract)
 
 ROUTE_POLICIES = ("affinity", "least_loaded", "round_robin")
 
@@ -198,7 +203,17 @@ class ReplicaRouter:
         except Exception:
             reqs = []   # engine too corrupt even for host-side extraction
         if reqs and not self.active_indices():
-            self.grow()
+            try:
+                self.grow()
+            except RuntimeError as e:
+                # Subprocess placement: the worker spawner raises once its
+                # respawn budget is spent. Last-resort growth failing must
+                # not escape the containment path — the requests below
+                # finish "failed", which is the honest outcome.
+                import sys
+
+                print(f"[router] last-resort grow failed: {e}",
+                      file=sys.stderr, flush=True)
         moved = 0
         tracer = get_tracer()
         for req in reqs:
@@ -207,7 +222,15 @@ class ReplicaRouter:
                 req._finish("failed")
                 continue
             dst = min(active, key=lambda i: (self._load(i), i))
-            self.engines[dst].adopt(req)
+            try:
+                self.engines[dst].adopt(req)
+            except Exception:
+                # The destination died between health checks (only worker
+                # handles can raise here — in-process adopt is a list
+                # append). Don't recurse into fail_replica mid-migration;
+                # the driver's next health sweep contains dst properly.
+                req._finish("failed")
+                continue
             req.replica = dst
             self.migrated += 1
             moved += 1
@@ -415,6 +438,11 @@ class ReplicaRouter:
             ),
             "prefill_batched": float(
                 sum(e.stats["prefill_batched"] for e in self.engines)
+            ),
+            # Subprocess placement: replacement workers spawned after a
+            # failure (the spawner counts them); always 0 in-process.
+            "worker_restarts": float(
+                getattr(self._make_engine, "respawns", 0)
             ),
         }
 

@@ -1,0 +1,91 @@
+"""Length-prefixed JSON-over-socket framing for the worker RPC plane.
+
+One frame = a 4-byte big-endian payload length followed by that many bytes
+of UTF-8 JSON. JSON keeps the wire debuggable (``socat`` a worker socket
+and read the traffic) and jax-free on the frontend side; the 4-byte prefix
+makes torn reads detectable — a worker SIGKILLed mid-reply leaves the
+parent with a short read, which surfaces as :class:`WireError`, never as a
+half-parsed message.
+
+This module imports neither jax nor anything from the serving package:
+``worker.py`` loads it before the engine import, and the frontend uses it
+without touching device state.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+# Version of the RPC envelope (framing + verb set). A worker and frontend
+# from different builds refuse each other loudly at hello time instead of
+# misinterpreting frames.
+WIRE_VERSION = 1
+
+# One frame holds at most one extracted fleet's worth of requests; 64 MiB
+# is ~16M tokens of JSON — far past any real payload, close enough to
+# catch a corrupt length prefix before a multi-GiB allocation.
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+_HEADER = struct.Struct(">I")
+
+
+class WireError(RuntimeError):
+    """Framing-level failure: peer gone (EOF / reset), timeout, oversize
+    or malformed frame. The driver treats any WireError from a worker RPC
+    as replica failure and trips the containment path."""
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    """Serialize ``obj`` and write one frame. Raises WireError if the peer
+    is gone (broken pipe / reset) or the send times out."""
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME_BYTES:
+        raise WireError(
+            f"refusing to send {len(payload)}-byte frame "
+            f"(cap {MAX_FRAME_BYTES})"
+        )
+    try:
+        sock.sendall(_HEADER.pack(len(payload)) + payload)
+    except (OSError, socket.timeout) as e:
+        raise WireError(f"send failed: {type(e).__name__}: {e}") from e
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout as e:
+            raise WireError(
+                f"recv timed out with {len(buf)}/{n} bytes read"
+            ) from e
+        except OSError as e:
+            raise WireError(f"recv failed: {type(e).__name__}: {e}") from e
+        if not chunk:
+            raise WireError(
+                f"peer closed with {len(buf)}/{n} bytes read"
+                if buf else "peer closed (EOF)"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> dict:
+    """Read one frame and decode it. Raises WireError on EOF, timeout,
+    oversize length prefix, or malformed JSON."""
+    (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if length > MAX_FRAME_BYTES:
+        raise WireError(
+            f"frame length {length} exceeds cap {MAX_FRAME_BYTES} "
+            "(corrupt prefix or version mismatch)"
+        )
+    payload = _recv_exact(sock, length)
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"malformed frame: {e}") from e
+    if not isinstance(obj, dict):
+        raise WireError(f"frame is {type(obj).__name__}, expected object")
+    return obj

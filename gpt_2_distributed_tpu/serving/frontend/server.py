@@ -540,12 +540,14 @@ def build_argparser() -> argparse.ArgumentParser:
         add_fault_flags,
         add_model_flags,
         add_obs_flags,
+        add_placement_flags,
     )
 
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     add_model_flags(p)
     add_engine_flags(p)
     add_obs_flags(p)
+    add_placement_flags(p)
     add_fault_flags(p)
     p.add_argument("--shutdown_join_s", type=float, default=30.0,
                    help="how long shutdown waits for the driver thread "
@@ -590,12 +592,14 @@ def main(argv: list[str] | None = None) -> None:
     args = p.parse_args(argv)
     if (args.ckpt is None) == (not args.init_random):
         p.error("exactly one of --ckpt / --init_random is required")
+    from gpt_2_distributed_tpu.config import validate_worker_flags
+
+    validate_worker_flags(p, args)
     if args.device:
         os.environ["JAX_PLATFORMS"] = args.device
 
     from gpt_2_distributed_tpu.obs.trace import get_tracer
     from gpt_2_distributed_tpu.resilience import PreemptionHandler
-    from gpt_2_distributed_tpu.serving import ServingEngine
     from gpt_2_distributed_tpu.serving.frontend.autoscale import Autoscaler
     from gpt_2_distributed_tpu.serving.frontend.router import ReplicaRouter
     from gpt_2_distributed_tpu.serving.serve import (
@@ -603,25 +607,47 @@ def main(argv: list[str] | None = None) -> None:
         load_model,
         make_injector,
         make_tracker,
+        model_config_from_args,
         setup_observability,
     )
 
     xla_capture = setup_observability(p, args)
-    config, params = load_model(args)
+    if args.placement == "subprocess":
+        # Weights live in the workers; the HTTP process never imports jax
+        # on the request path — a replica crash can't take the server down.
+        config = model_config_from_args(args)
+        params = None
+    else:
+        config, params = load_model(args)
     serve = build_serve_config(args, config)
 
     max_replicas = args.max_replicas
     if max_replicas is None:
         max_replicas = args.replicas
+    if args.placement == "subprocess":
+        from gpt_2_distributed_tpu.serving.frontend.worker import (
+            spawner_from_args,
+        )
+
+        make_engine = spawner_from_args(
+            args, serve, initial_replicas=args.replicas
+        )
+    else:
+        from gpt_2_distributed_tpu.serving import ServingEngine
+
+        def make_engine():
+            return ServingEngine(params, config, serve,
+                                 temperature=args.temperature,
+                                 top_k=args.top_k)
     try:
         router = ReplicaRouter(
-            lambda: ServingEngine(params, config, serve,
-                                  temperature=args.temperature,
-                                  top_k=args.top_k),
+            make_engine,
             replicas=args.replicas, max_replicas=max_replicas,
             policy=args.route, ttft_slo_ms=args.ttft_slo_ms,
             queue_slo_ms=args.queue_slo_ms,
         )
+        if args.placement == "subprocess":
+            make_engine.router = router  # respawn-vs-scale-up attribution
         autoscaler = Autoscaler(
             router, min_replicas=args.min_replicas,
             max_replicas=max_replicas,

@@ -123,6 +123,34 @@ def add_obs_flags(p: argparse.ArgumentParser) -> None:
                    help="jax platform override (cpu|tpu)")
 
 
+def add_placement_flags(p: argparse.ArgumentParser) -> None:
+    """Replica placement + worker supervision flags, shared by the JSONL
+    CLI, the HTTP front end and the chaos bench. Validated jax-free via
+    ``config.validate_worker_flags``."""
+    p.add_argument("--placement", default="inprocess",
+                   choices=["inprocess", "subprocess"],
+                   help="replica placement: engines inside this process "
+                        "(default), or one worker process per replica "
+                        "behind the RPC supervision plane")
+    p.add_argument("--worker_max_respawns", type=int, default=3,
+                   help="replacement workers spawned after failures before "
+                        "the fleet degrades loudly (supervise.sh "
+                        "MAX_RESTARTS semantics)")
+    p.add_argument("--worker_respawn_backoff_s", type=float, default=2.0,
+                   help="base respawn backoff; doubles per respawn "
+                        "(supervise.sh RESTART_DELAY semantics)")
+    p.add_argument("--worker_rpc_timeout_s", type=float, default=300.0,
+                   help="per-RPC reply deadline; a worker that blows it "
+                        "is failed and its requests migrated (generous "
+                        "default: cold XLA compiles ride the step RPC)")
+    p.add_argument("--worker_heartbeat_s", type=float, default=1.0,
+                   help="idle gap after which the driver heartbeats a "
+                        "worker; heartbeat loss fails the replica")
+    p.add_argument("--worker_connect_timeout_s", type=float, default=120.0,
+                   help="worker spawn-to-hello deadline (covers the "
+                        "child's jax import + engine build)")
+
+
 def add_fault_flags(p: argparse.ArgumentParser) -> None:
     """Fault-tolerance + fault-injection flags, shared with the front end
     and the chaos bench."""
@@ -193,6 +221,7 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--stream", action="store_true",
                    help="emit a JSON line per token as it is generated")
     add_obs_flags(p)
+    add_placement_flags(p)
     add_fault_flags(p)
     return p
 
@@ -219,14 +248,11 @@ def setup_observability(p: argparse.ArgumentParser, args: argparse.Namespace):
     return XlaCapture(xla_profile_spec, profile_root)
 
 
-def load_model(args: argparse.Namespace):
-    """(config, params) from --model overrides + checkpoint/--init_random.
-    Call after the jax platform is pinned."""
-    import jax
-
-    from gpt_2_distributed_tpu.checkpoint import latest_checkpoint, restore_params
+def model_config_from_args(args: argparse.Namespace):
+    """GPT2Config from --model + overrides, WITHOUT touching params or
+    jax — subprocess placement needs the config (pool sizing, prompt
+    validation) while the weights load only inside the workers."""
     from gpt_2_distributed_tpu.config import MODEL_PRESETS
-    from gpt_2_distributed_tpu.models import gpt2
 
     overrides = {
         k: getattr(args, k)
@@ -235,7 +261,18 @@ def load_model(args: argparse.Namespace):
     }
     if args.seq_len is not None:
         overrides["n_positions"] = args.seq_len
-    config = MODEL_PRESETS[args.model].replace(**overrides)
+    return MODEL_PRESETS[args.model].replace(**overrides)
+
+
+def load_model(args: argparse.Namespace):
+    """(config, params) from --model overrides + checkpoint/--init_random.
+    Call after the jax platform is pinned."""
+    import jax
+
+    from gpt_2_distributed_tpu.checkpoint import latest_checkpoint, restore_params
+    from gpt_2_distributed_tpu.models import gpt2
+
+    config = model_config_from_args(args)
 
     if args.init_random:
         params = gpt2.init_params(config)
@@ -303,17 +340,26 @@ def main(argv: list[str] | None = None) -> None:
     args = p.parse_args(argv)
     if (args.ckpt is None) == (not args.init_random):
         p.error("exactly one of --ckpt / --init_random is required")
+    from gpt_2_distributed_tpu.config import validate_worker_flags
+
+    validate_worker_flags(p, args)
     if args.device:
         os.environ["JAX_PLATFORMS"] = args.device
 
     from gpt_2_distributed_tpu.obs.trace import get_tracer
     from gpt_2_distributed_tpu.resilience import PreemptionHandler
-    from gpt_2_distributed_tpu.serving import ServingEngine
     from gpt_2_distributed_tpu.serving.frontend.driver import EngineDriver
     from gpt_2_distributed_tpu.serving.frontend.router import ReplicaRouter
 
     xla_capture = setup_observability(p, args)
-    config, params = load_model(args)
+    if args.placement == "subprocess":
+        # The frontend stays off the device: weights load inside the
+        # worker processes; the parent only needs the model SHAPE for
+        # pool sizing and prompt validation.
+        config = model_config_from_args(args)
+        params = None
+    else:
+        config, params = load_model(args)
 
     lines = (sys.stdin if args.requests == "-"
              else open(args.requests, encoding="utf-8"))
@@ -355,11 +401,22 @@ def main(argv: list[str] | None = None) -> None:
         sys.exit("--requests: no requests")
 
     serve = build_serve_config(args, config)
-    router = ReplicaRouter(
-        lambda: ServingEngine(params, config, serve,
-                              temperature=args.temperature, top_k=args.top_k),
-        replicas=1,
-    )
+    if args.placement == "subprocess":
+        from gpt_2_distributed_tpu.serving.frontend.worker import (
+            spawner_from_args,
+        )
+
+        make_engine = spawner_from_args(args, serve, initial_replicas=1)
+    else:
+        from gpt_2_distributed_tpu.serving import ServingEngine
+
+        def make_engine():
+            return ServingEngine(params, config, serve,
+                                 temperature=args.temperature,
+                                 top_k=args.top_k)
+    router = ReplicaRouter(make_engine, replicas=1)
+    if args.placement == "subprocess":
+        make_engine.router = router  # respawn-vs-scale-up attribution
     tracker = make_tracker(args)
     # SIGTERM = finish what was accepted, exit 0. Every request below is
     # submitted before the loop starts, so the flag can only ever shorten

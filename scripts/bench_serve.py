@@ -63,6 +63,15 @@ engines' token streams are bit-identical. The CPU win comes from batching
 fixed per-op overhead; on TPU the same structure amortizes weight reads
 across rows, which is the real prize.
 
+``--placement subprocess --chaos`` is the process-isolation proof: the
+same seeded trace through per-device worker PROCESSES, with replica 0
+killed by ``--chaos_kill {exception,sigkill,sigstop}`` mid-decode — real
+signals, real corpses, supervision detecting them out-of-band. Merges a
+``chaos_proc`` record (keyed by kill mechanism) carrying the RPC-hop
+A/B (in-process vs subprocess clean replays) and bit-parity verdicts
+for greedy and sampled decoding; exits nonzero on no-fire, divergence,
+or any re-emitted token.
+
 Flag combos the bench can't honor are refused at parse time (mirroring
 bench.py's --suite rejection), before any jax import.
 """
@@ -215,6 +224,21 @@ def build_argparser() -> argparse.ArgumentParser:
                    metavar="STEP",
                    help="raise in whichever replica steps first at fleet "
                    "step STEP")
+    # Process-isolated chaos (PR 18). The placement/worker flags are the
+    # same ones gpt2-tpu-serve and gpt2-tpu-frontend take; serving.serve
+    # is importable jax-free (the serving package exports lazily), so
+    # sharing them keeps the three CLIs from drifting without breaking
+    # this CLI's poisoned-jax --help contract.
+    from gpt_2_distributed_tpu.serving.serve import add_placement_flags
+
+    add_placement_flags(p)
+    p.add_argument("--chaos_kill", default="exception",
+                   choices=["exception", "sigkill", "sigstop"],
+                   help="chaos failure mechanism: 'exception' raises in "
+                   "the replica's step (any placement); 'sigkill'/"
+                   "'sigstop' send the REAL signal to a subprocess "
+                   "worker's pid (needs --placement subprocess) — "
+                   "supervision must detect the corpse/stall itself")
     p.add_argument("--json", default="BENCH_SERVE.json", metavar="PATH",
                    help="result file ('' disables the write); front-door "
                    "and chaos modes merge their record into an existing "
@@ -324,6 +348,26 @@ def validate_args(p: argparse.ArgumentParser, args: argparse.Namespace) -> None:
     if args.hang_spec is not None and args.watchdog_timeout_s is None:
         p.error("--inject_replica_hang_at needs --watchdog_timeout_s "
                 "(nothing else ever detects the hang)")
+    # Placement + worker supervision (jax-free: config.py imports no jax).
+    from gpt_2_distributed_tpu.config import validate_worker_flags
+
+    validate_worker_flags(p, args)
+    if args.chaos_kill != "exception" and args.placement != "subprocess":
+        p.error(f"--chaos_kill {args.chaos_kill}: real signals need "
+                "--placement subprocess (an in-process replica has no pid "
+                "of its own to kill)")
+    if args.placement == "subprocess":
+        if not args.chaos:
+            p.error("--placement subprocess: the bench wires subprocess "
+                    "workers through --chaos only (the closed-trace and "
+                    "front-door paths reach into engine internals no RPC "
+                    "surface exposes)")
+        if (args.hang_spec is not None
+                or args.inject_step_exception is not None):
+            p.error("--placement subprocess chaos is driven by "
+                    "--chaos_kill (+ optional --inject_replica_fail_at "
+                    "for the trigger step); drop --inject_replica_hang_at"
+                    "/--inject_step_exception")
     any_inject = (args.fail_spec is not None or args.hang_spec is not None
                   or args.inject_step_exception is not None)
     if args.chaos:
@@ -860,6 +904,215 @@ def run_chaos(args, config, serve, jax, np, make_engine, make_inj):
     }
 
 
+def run_chaos_proc(args, params, config, serve, jax, np):
+    """Process-isolation chaos (``--placement subprocess``): the seeded
+    closed trace replayed through per-device worker PROCESSES, with the
+    victim killed by ``--chaos_kill`` mid-decode.
+
+    Six replays of the one trace — for greedy and sampled decoding each:
+
+    1. ``inprocess`` — the PR 16 in-process fleet: the reference streams
+       and the RPC-overhead baseline.
+    2. ``subprocess`` — a clean worker fleet: same tokens, slower by the
+       RPC hop (the A/B that prices process isolation; PERF_ANALYSIS §19).
+    3. ``subprocess_kill`` — replica 0 takes the real signal (or an
+       injected step exception) mid-run; the supervision plane must
+       detect it out-of-band, migrate every in-flight stream off the
+       corpse via the serialized wire form, and respawn a replacement
+       through the autoscaler's below-min path.
+
+    Every stream in every replay must match the in-process reference
+    bit-for-bit, and the kill replay must re-emit nothing — main() exits
+    nonzero otherwise, so a committed ``chaos_proc`` record IS the proof.
+    """
+    import copy
+    import signal as _sig
+
+    from gpt_2_distributed_tpu.resilience import FaultInjector
+    from gpt_2_distributed_tpu.serving import ServingEngine
+    from gpt_2_distributed_tpu.serving.frontend.autoscale import Autoscaler
+    from gpt_2_distributed_tpu.serving.frontend.driver import EngineDriver
+    from gpt_2_distributed_tpu.serving.frontend.router import ReplicaRouter
+    from gpt_2_distributed_tpu.serving.frontend.worker import (
+        spawner_from_args,
+    )
+
+    shared = args.traces != "original"
+    trace = make_trace(args, np, config.vocab_size, shared=shared)
+    arrivals, prompts, news, meta = trace
+    n = len(prompts)
+    keys = [jax.random.PRNGKey(args.trace_seed * 100_000 + i)
+            for i in range(n)]
+    kill_step, kill_replica = args.fail_spec
+    kill_replica = kill_replica if kill_replica is not None else 0
+    kill_sig = {"sigkill": _sig.SIGKILL,
+                "sigstop": _sig.SIGSTOP}.get(args.chaos_kill)
+
+    def replay(temp, placement, kill=False):
+        spawner = None
+        if placement == "subprocess":
+            a = copy.copy(args)
+            a.temperature = temp
+            a.ckpt, a.init_random = None, True  # same seeded init weights
+            spawner = spawner_from_args(a, serve,
+                                        initial_replicas=args.replicas)
+            factory = spawner
+        else:
+            def factory():
+                return ServingEngine(params, config, serve,
+                                     temperature=temp, top_k=args.top_k)
+        router = ReplicaRouter(
+            factory, replicas=args.replicas,
+            # +1 headroom on the kill run only: a FAILED replica keeps its
+            # index and counts against the ceiling, and the replacement
+            # worker needs a free slot to spawn into.
+            max_replicas=args.replicas + (1 if kill else 0),
+            policy=args.route,
+        )
+        if spawner is not None:
+            spawner.router = router
+        injector = scaler = None
+        if kill:
+            # Supervision under test: the autoscaler's below-min
+            # replacement path respawns the victim. The first tick lands
+            # AFTER the kill step, so migration (immediate, inside
+            # fail_replica) always precedes the respawn.
+            scaler = Autoscaler(router, min_replicas=args.replicas,
+                                max_replicas=args.replicas + 1)
+            if kill_sig is not None:
+                injector = FaultInjector(
+                    kill_at=(kill_step, kill_replica),
+                    kill_fn=lambda r: router.engines[r].kill(kill_sig),
+                )
+            else:
+                injector = FaultInjector(fail_at=(kill_step, kill_replica))
+        driver = EngineDriver(
+            router, autoscaler=scaler,
+            autoscale_every=max(25, kill_step + 1),
+            request_timeout_s=args.request_timeout_s,
+            watchdog_timeout_s=args.watchdog_timeout_s, injector=injector,
+        )
+        # Same per-replica compile warmup as run_chaos — for subprocess
+        # placement every call here is an RPC and the compiles happen in
+        # the worker processes.
+        bs = serve.block_size
+        cap = config.n_positions - 2
+        buckets = ({-(-max(len(pr) for pr in prompts) // bs)}
+                   if serve.prefill_chunk else
+                   {-(-len(pr) // bs) for pr in prompts})
+        for eng in router.engines:
+            for nb in sorted(buckets):
+                eng.submit([3 + nb] * min(nb * bs, cap), 2, rng=0)
+            eng.run_until_idle()
+            eng.clear_prefix_cache()
+            eng.stats = {k: type(v)() for k, v in eng.stats.items()}
+        if kill and args.chaos_kill == "sigstop":
+            # A SIGSTOPped worker answers nothing: detection IS the step
+            # RPC timing out. Cap the victim's patience once warmup is
+            # done (the respawned replacement keeps the spawner's full
+            # budget for its own lazy compiles).
+            victim = router.engines[kill_replica]
+            victim.rpc_timeout_s = min(victim.rpc_timeout_s, 10.0)
+
+        tok_times: dict[int, list[float]] = {}
+
+        def on_token(req, _tok, _tt=tok_times):
+            _tt.setdefault(req.id, []).append(time.monotonic())
+
+        handles = []
+        placed: dict[int, int] = {}
+        t_fail = None
+        nxt = 0
+        t0 = time.monotonic()
+        while nxt < n or driver.has_work():
+            now = time.monotonic() - t0
+            while nxt < n and arrivals[nxt] <= now:
+                h = driver.submit(prompts[nxt], int(news[nxt]),
+                                  rng=keys[nxt], on_token=on_token)
+                placed[h.id] = h.replica
+                handles.append(h)
+                nxt += 1
+            if driver.has_work():
+                driver.step()
+                if t_fail is None and router.replica_failures:
+                    t_fail = time.monotonic()
+            elif nxt < n:
+                time.sleep(min(0.001, max(0.0, arrivals[nxt] - now)))
+        wall = time.monotonic() - t0
+        driver.close()
+        assert all(h.done for h in handles)
+
+        migrated = [h for h in handles if h.replica != placed[h.id]]
+        recovery = None
+        if t_fail is not None and migrated:
+            resumed = [min((t for t in tok_times.get(h.id, [])
+                            if t > t_fail), default=None) for h in migrated]
+            if all(r is not None for r in resumed):
+                recovery = max(resumed) - t_fail
+        emitted = sum(len(h.generated) for h in handles)
+        rec = {
+            "wall_s": round(wall, 4),
+            "tok_s": round(emitted / wall, 1),
+            "completed": sum(h.finish_reason in ("eos", "length")
+                             for h in handles),
+            "replica_failures": router.replica_failures,
+            "migrated_streams": router.migrated,
+            "watchdog_trips": driver.watchdog_trips,
+            "timeouts": sum(h.finish_reason == "timeout" for h in handles),
+            "failed_streams": sum(h.finish_reason == "failed"
+                                  for h in handles),
+            "re_emitted_tokens": sum(
+                len(tok_times.get(h.id, [])) - len(h.generated)
+                for h in handles
+            ),
+            "recovery_s": (round(recovery, 4) if recovery is not None
+                           else None),
+        }
+        if spawner is not None:
+            rec["worker_restarts"] = spawner.respawns
+        return rec, [list(h.generated) for h in handles]
+
+    out = {
+        "kill": args.chaos_kill,
+        "trace": meta,
+        "replicas": args.replicas,
+        "policy": args.route,
+        "fail_at": f"{kill_step}:{kill_replica}",
+        "serve": {"max_batch": serve.max_batch,
+                  "block_size": serve.block_size,
+                  "num_blocks": serve.num_blocks,
+                  "prefill_chunk": serve.prefill_chunk,
+                  "prefix_cache": serve.prefix_cache,
+                  "admission": serve.admission},
+        "worker": {"max_respawns": args.worker_max_respawns,
+                   "respawn_backoff_s": args.worker_respawn_backoff_s,
+                   "rpc_timeout_s": args.worker_rpc_timeout_s,
+                   "heartbeat_s": args.worker_heartbeat_s},
+    }
+    for mode, temp in (("greedy", 0.0), ("sampled", 1.0)):
+        ref_rec, ref_streams = replay(temp, "inprocess")
+        sub_rec, sub_streams = replay(temp, "subprocess")
+        kill_rec, kill_streams = replay(temp, "subprocess", kill=True)
+        out[mode] = {
+            "inprocess": ref_rec,
+            "subprocess": sub_rec,
+            "subprocess_kill": kill_rec,
+            "streams_bit_identical": (sub_streams == ref_streams
+                                      and kill_streams == ref_streams),
+        }
+    g = out["greedy"]
+    out["rpc_overhead"] = {
+        "inprocess_tok_s": g["inprocess"]["tok_s"],
+        "subprocess_tok_s": g["subprocess"]["tok_s"],
+        # Per-token cost of the hop: difference of the clean replays'
+        # seconds-per-token. Positive = the RPC plane costs time.
+        "per_token_overhead_us": round(
+            (1.0 / g["subprocess"]["tok_s"]
+             - 1.0 / g["inprocess"]["tok_s"]) * 1e6, 1),
+    }
+    return out
+
+
 def main(argv=None) -> None:
     p = build_argparser()
     args = p.parse_args(argv)
@@ -1003,6 +1256,46 @@ def main(argv=None) -> None:
         return FaultInjector(fail_at=args.fail_spec,
                              hang_at=args.hang_spec,
                              exception_at=args.inject_step_exception)
+
+    if args.chaos and args.placement == "subprocess":
+        serve_new, _ = serve_pair(
+            args.num_blocks_shared or args.num_blocks
+            if args.traces != "original" else args.num_blocks
+        )
+        rec = run_chaos_proc(args, params, config, serve_new, jax, np)
+        _XLA_CAPTURE.stop_if_active()
+        get_tracer().close()
+        if args.json:
+            out = {"bench": "serve",
+                   "device": jax.devices()[0].device_kind,
+                   "n_devices": jax.device_count(),
+                   "model": {"preset": args.model, **overrides}}
+            if os.path.exists(args.json):
+                with open(args.json) as f:
+                    out = json.load(f)
+            # Keyed by kill mechanism: one invocation per --chaos_kill,
+            # records accumulate in the same file.
+            out.setdefault("chaos_proc", {})[args.chaos_kill] = rec
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps({"chaos_proc": {args.chaos_kill: rec}}))
+        for mode in ("greedy", "sampled"):
+            krec = rec[mode]["subprocess_kill"]
+            if krec["replica_failures"] == 0:
+                sys.exit(f"chaos_proc[{mode}]: the {args.chaos_kill} kill "
+                         "never fired — the run finished before its "
+                         "trigger step; lower --inject_replica_fail_at")
+            if not rec[mode]["streams_bit_identical"]:
+                sys.exit(f"chaos_proc[{mode}]: token streams diverged "
+                         "from the in-process reference — the process "
+                         "boundary broke bit-exactness")
+            if krec["re_emitted_tokens"] != 0:
+                sys.exit(f"chaos_proc[{mode}]: "
+                         f"{krec['re_emitted_tokens']} token(s) were "
+                         "re-emitted across the migration — the "
+                         "zero-re-emission contract is broken")
+        return
 
     if args.chaos:
         serve_new, _ = serve_pair(

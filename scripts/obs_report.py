@@ -289,6 +289,44 @@ def mesh_summary(records: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def worker_lifecycle(records: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Subprocess-placement supervision timeline: every ``worker_spawn``,
+    ``worker_respawn`` (the backoff before a replacement spawn) and
+    ``heartbeat_loss`` event, in time order. Spawns that replaced a dead
+    worker carry ``respawn > 0``; a healthy fleet shows only the initial
+    spawns. None when the run never used subprocess placement."""
+    names = {"worker_spawn", "worker_respawn", "heartbeat_loss"}
+    evs = sorted(
+        (r for r in records
+         if r.get("ph") == "event" and r.get("name") in names),
+        key=lambda e: e["ts"],
+    )
+    if not evs:
+        return None
+    t0 = evs[0]["ts"]
+    rows = []
+    for e in evs:
+        a = e.get("attrs", {})
+        row = {"event": e["name"], "t_ms": round((e["ts"] - t0) * 1e3, 1)}
+        if e["name"] == "worker_spawn":
+            row["pid"] = a.get("pid")
+            row["spawn"] = a.get("spawn")
+            row["respawn"] = a.get("respawn")
+        elif e["name"] == "worker_respawn":
+            row["respawn"] = a.get("respawn")
+            row["backoff_s"] = a.get("backoff_s")
+        else:  # heartbeat_loss
+            row["pid"] = a.get("pid")
+        rows.append(row)
+    return {
+        "n_spawns": sum(1 for r in rows if r["event"] == "worker_spawn"),
+        "n_respawns": sum(1 for r in rows if r["event"] == "worker_respawn"),
+        "n_heartbeat_losses": sum(
+            1 for r in rows if r["event"] == "heartbeat_loss"),
+        "events": rows,
+    }
+
+
 def build_report(trace_dir: str) -> dict[str, Any]:
     records = load_trace_dir(trace_dir)
     serving = request_waterfall(records)
@@ -300,6 +338,7 @@ def build_report(trace_dir: str) -> dict[str, Any]:
         "serving": serving,
         "frontend": frontend_summary(serving),
         "meshes": mesh_summary(records),
+        "workers": worker_lifecycle(records),
     }
 
 
@@ -366,6 +405,24 @@ def _print_frontend(report: dict[str, Any], limit: int) -> None:
     if fs["n_migrated"] or fs["n_timed_out"] or fs["n_failed"]:
         print(f"  fault tolerance: {fs['n_migrated']} migrated, "
               f"{fs['n_timed_out']} timed out, {fs['n_failed']} failed")
+    workers = report.get("workers")
+    if workers:
+        print(f"  worker lifecycle: {workers['n_spawns']} spawn(s), "
+              f"{workers['n_respawns']} respawn(s), "
+              f"{workers['n_heartbeat_losses']} heartbeat loss(es)")
+        for w in workers["events"]:
+            if w["event"] == "worker_spawn":
+                tag = (f"respawn #{w['respawn']}" if w.get("respawn")
+                       else f"initial spawn #{w.get('spawn')}")
+                print(f"    +{w['t_ms']:>9.1f} ms  worker_spawn    "
+                      f"pid={w.get('pid')}  ({tag})")
+            elif w["event"] == "worker_respawn":
+                print(f"    +{w['t_ms']:>9.1f} ms  worker_respawn  "
+                      f"#{w.get('respawn')} after "
+                      f"{w.get('backoff_s', 0):g}s backoff")
+            else:
+                print(f"    +{w['t_ms']:>9.1f} ms  heartbeat_loss  "
+                      f"pid={w.get('pid')}")
     print(f"  {'rid':<8} {'replica':>7} {'policy':<12} {'aff_blk':>7} "
           f"{'queue_ms':>9} {'ttft_ms':>9} {'finish_ms':>10}")
     shown = 0

@@ -53,29 +53,15 @@ def forced_host_device_env(n_devices: int,
     """Subprocess env pinned to exactly ``n_devices`` virtual CPU devices.
 
     The same force-before-jax-import dance this conftest does for the test
-    process itself (JAX_PLATFORMS=cpu, any pre-existing forced count in
-    XLA_FLAGS replaced, repo on PYTHONPATH), packaged for child processes:
-    the multi-host pair tests (4 devices per rank) and the sharded-serving
-    subprocess runs (8-device engines driven through scripts/bench_serve.py)
-    both launch workers through it, so the pattern can't drift between
-    suites. ``extra`` overlays additional vars last.
+    process itself, packaged for child processes. The implementation lives
+    in ``gpt_2_distributed_tpu.resilience.forced_host_device_env`` — the
+    worker spawner uses it to pin process-isolated serving replicas on CPU
+    hosts — and this delegation keeps test subprocesses on the exact same
+    env recipe. ``extra`` overlays additional vars last.
     """
-    env = dict(os.environ)
-    flags = re.sub(
-        r"--xla_force_host_platform_device_count=\d+", "",
-        env.get("XLA_FLAGS", ""),
-    ).strip()
-    env["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={n_devices}"
-    ).strip()
-    env["JAX_PLATFORMS"] = "cpu"
-    env["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"
-    env["PYTHONPATH"] = (
-        REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    ).rstrip(os.pathsep)
-    if extra:
-        env.update(extra)
-    return env
+    from gpt_2_distributed_tpu.resilience import forced_host_device_env as f
+
+    return f(n_devices, extra)
 
 
 @pytest.fixture(scope="session")

@@ -194,6 +194,8 @@ def test_update_call_sites_found():
     assert "serve_mesh_devices" in names
     assert "kv_pool_bytes_per_device" in names
     assert "prefill_batched" in names
+    # PR 18 process isolation: replacement-worker counter (router snapshot)
+    assert "worker_restarts" in names
 
 
 def test_every_pushed_metric_is_registered():
