@@ -98,6 +98,18 @@ class CheckpointMeta:
     # global_batch, grad_accum_steps, batch, local_batch, workers. None for
     # pre-elastic checkpoints (same legacy-JSON contract as spike_monitor).
     world: dict | None = None
+    # Same-epoch data-cursor history (PR 19): present only on checkpoints
+    # saved by a world that resumed mid-epoch after a resize. Keys:
+    # "epoch" (the partially-consumed epoch), "digest"
+    # (dataloader.cursor_plan_digest of the consumed-window plan this world
+    # trains the complement of), "windows" (plan size, for logs), and
+    # "resizes" — the fold replay_cursor_history needs: one entry per
+    # prior world with process_count/workers/local_batch/grad_accum_steps/
+    # steps. A SECOND same-epoch resize recomputes the plan from this
+    # record and refuses to resume if the digest diverged (shards changed
+    # under a half-consumed epoch). None everywhere else (legacy-JSON
+    # contract as above).
+    cursor_plan: dict | None = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2)

@@ -16,6 +16,7 @@ which keeps XLA compile time flat from 12 to 48 layers).
 from __future__ import annotations
 
 import dataclasses
+import os
 from dataclasses import dataclass
 
 # Row-chunk default for the blocked CE (ops/losses.py imports it back from
@@ -485,8 +486,10 @@ def parse_serve_mesh(mesh: str) -> tuple[int, int]:
 # Replica placement modes for the serving frontend: `inprocess` builds
 # every ServingEngine inside the frontend process (the default — zero RPC
 # overhead, shared fate); `subprocess` hosts one engine per worker process
-# behind the RPC supervision plane (process-level blast radius).
-PLACEMENTS = ("inprocess", "subprocess")
+# behind the RPC supervision plane (process-level blast radius); `remote`
+# adopts pre-started workers listening on tcp://host:port (named by a
+# --worker_pool file), extending the blast radius story to whole hosts.
+PLACEMENTS = ("inprocess", "subprocess", "remote")
 
 
 def validate_worker_flags(p, args) -> None:
@@ -523,6 +526,43 @@ def validate_worker_flags(p, args) -> None:
         p.error(
             f"--worker_connect_timeout_s must be > 0, "
             f"got {args.worker_connect_timeout_s}"
+        )
+    # The cross-host flags arrived after the subprocess family; getattr
+    # keeps this helper usable on namespaces that predate them (embedders
+    # building their own argparse.Namespace).
+    hb_timeout = getattr(args, "worker_heartbeat_timeout_s", None)
+    if hb_timeout is not None and hb_timeout <= 0:
+        p.error(
+            f"--worker_heartbeat_timeout_s must be > 0, "
+            f"got {hb_timeout}"
+        )
+    if getattr(args, "worker_auth_token_file", None) is not None:
+        # Refuse a bad token file at parse time (rpc.py is jax-free): a
+        # fleet that cannot authenticate must not get as far as spawning.
+        from gpt_2_distributed_tpu.serving.frontend.rpc import (
+            load_auth_token,
+        )
+
+        try:
+            load_auth_token(args.worker_auth_token_file)
+        except (OSError, ValueError) as e:
+            p.error(f"--worker_auth_token_file: {e}")
+    pool = getattr(args, "worker_pool", None)
+    if args.placement == "remote":
+        if not pool:
+            p.error(
+                "--placement remote needs --worker_pool (a file of "
+                "'host_id address' lines naming the fleet; workers "
+                "append themselves with gpt2-tpu-worker --advertise)"
+            )
+        if not os.path.exists(pool):
+            p.error(
+                f"--worker_pool {pool!r}: file not found"
+            )
+    elif pool:
+        p.error(
+            f"--worker_pool only makes sense with --placement remote, "
+            f"not {args.placement!r}"
         )
 
 

@@ -440,6 +440,7 @@ def plan_cursor_migration(
     old_num_workers: int,
     old_batch_size: int,
     consumed_batches: int,
+    consumed: dict[str, set] | None = None,
 ) -> dict[str, set]:
     """Reconstruct exactly which windows the OLD world consumed this epoch.
 
@@ -456,11 +457,15 @@ def plan_cursor_migration(
     world shape: the new world trains on exactly the complement.
 
     ``consumed_batches`` is per old process (identical across processes:
-    optimizer steps into the epoch x the old world's grad-accum). Limitation:
-    after a SECOND resize within the same epoch the old world's own consumed
-    set is not recoverable from the latest checkpoint alone, so the plan
-    treats the latest world as having consumed the whole epoch prefix —
-    approximate there, exact everywhere else.
+    optimizer steps into the epoch x the old world's grad-accum).
+
+    ``consumed`` handles the SECOND-resize case: when the old world was
+    itself resumed mid-epoch, it trained on the COMPLEMENT of an earlier
+    plan, not the virgin stream — pass that earlier plan and the
+    simulation runs on the same filtered offset lists (and filtered batch
+    counts) the old world's loader actually walked, keeping the
+    reconstruction exact at any resize depth (see
+    :func:`replay_cursor_history`).
     """
     plan: dict[str, set] = {}
     for p in range(old_process_count):
@@ -472,6 +477,8 @@ def plan_cursor_migration(
             num_workers=old_num_workers,
         )
         old.set_epoch(epoch)
+        if consumed:
+            old.set_consumed(consumed, epoch)
         counts = old.worker_batches(old_batch_size)
         skipped, _, _ = _simulate_round_robin_skip(counts, consumed_batches)
         for w in range(old.num_workers):
@@ -482,10 +489,79 @@ def plan_cursor_migration(
                 n = _shard_token_count(path)
                 offsets = list(range(0, n - seq_len - 1, seq_len))
                 random.Random(_offset_seed(epoch, p, w)).shuffle(offsets)
+                if consumed:
+                    # Mirror _iter_one_shard: shuffle first, THEN drop
+                    # already-consumed windows, preserving survivor order.
+                    gone = consumed.get(path, ())
+                    offsets = [o for o in offsets if o not in gone]
                 take = min(samples, len(offsets))
                 if take:
                     plan.setdefault(path, set()).update(offsets[:take])
                 samples -= take
+    return plan
+
+
+def cursor_plan_digest(plan: dict[str, set]) -> str:
+    """Stable content digest of a consumed-window plan.
+
+    Keyed by shard *basename* (data roots legitimately move between
+    machines; shard identity does not) with sorted offsets, so two
+    reconstructions of the same consumption history agree iff they name
+    the same windows. Persisted in ``CheckpointMeta.cursor_plan`` and
+    re-verified on the next same-epoch resize — a mismatch means the
+    shard files or the planner's determinism changed underneath a
+    half-consumed epoch, which must fail loudly instead of silently
+    double-reading or dropping windows.
+    """
+    import hashlib
+    import json
+
+    canon = sorted(
+        (os.path.basename(path), sorted(int(o) for o in offs))
+        for path, offs in plan.items()
+        if offs
+    )
+    return hashlib.sha256(
+        json.dumps(canon, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
+def replay_cursor_history(
+    shard_paths: Sequence[str],
+    seq_len: int,
+    epoch: int,
+    resizes: Sequence[dict],
+) -> dict[str, set]:
+    """Fold a same-epoch resize history into one exact consumed-window plan.
+
+    ``resizes`` is the record ``CheckpointMeta.cursor_plan`` carries: one
+    entry per world that trained part of this epoch, in order, each with
+    the world's data shape (``process_count``/``workers``/``local_batch``/
+    ``grad_accum_steps``) and ``steps`` — the optimizer-step count into
+    the epoch at which that world handed over. Each world's consumption
+    is simulated on the complement of everything consumed before it, so
+    the union stays exact at any resize depth — this replaces the old
+    single-resize limitation where a second same-epoch resize silently
+    treated the latest world as having consumed a virgin prefix.
+    """
+    plan: dict[str, set] = {}
+    prev_steps = 0
+    for r in resizes:
+        steps = int(r["steps"])
+        step_plan = plan_cursor_migration(
+            shard_paths,
+            seq_len=seq_len,
+            epoch=epoch,
+            old_process_count=int(r["process_count"]),
+            old_num_workers=int(r["workers"]),
+            old_batch_size=int(r["local_batch"]),
+            consumed_batches=(steps - prev_steps)
+            * int(r["grad_accum_steps"]),
+            consumed=plan or None,
+        )
+        for path, offs in step_plan.items():
+            plan.setdefault(path, set()).update(offs)
+        prev_steps = steps
     return plan
 
 

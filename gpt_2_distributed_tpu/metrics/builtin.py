@@ -260,6 +260,8 @@ for _name, _dist in (
     ("kv_pool_bytes_per_device", "max"),  # largest per-device KV pool footprint
     ("prefill_batched", "sum"),        # cumulative extra rows batched into prefills
     ("worker_restarts", "sum"),        # cumulative replacement worker respawns
+    ("host_failures", "sum"),          # cumulative whole-host domains lost
+    ("hosts_active", "max"),           # remote fleet hosts not quarantined
 ):
     METRIC_REGISTRY.metric(
         _name, reduction=ReductionStrategy.CURRENT, tb_prefix="serve/",

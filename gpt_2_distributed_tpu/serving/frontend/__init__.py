@@ -23,8 +23,11 @@ _EXPORTS = {
     "ReplicaRouter": "router",
     "ShedError": "router",
     "WireError": "rpc",
+    "ChaosProxy": "netchaos",
+    "RemoteSpawner": "worker",
     "WorkerHandle": "worker",
     "WorkerSpawner": "worker",
+    "read_worker_pool": "worker",
 }
 
 __all__ = sorted(_EXPORTS)

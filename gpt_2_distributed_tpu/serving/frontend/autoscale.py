@@ -85,6 +85,7 @@ class Autoscaler:
         self._seen_violations = router.slo_violations
         # getattr: unit-test FakeRouters predate the failure surface.
         self._seen_failures = getattr(router, "replica_failures", 0)
+        self._seen_host_failures = getattr(router, "host_failures", 0)
         self.scale_ups = 0
         self.scale_downs = 0
         self.replacements = 0
@@ -95,14 +96,21 @@ class Autoscaler:
         new_viol = self.router.slo_violations - self._seen_violations
         fails = getattr(self.router, "replica_failures", 0)
         new_fails = fails - self._seen_failures
+        # A lost host already bumped replica_failures once per worker; the
+        # separate counter exists so host-scale loss registers as pressure
+        # even when its workers were all idle parked replicas.
+        hfails = getattr(self.router, "host_failures", 0)
+        new_hfails = hfails - self._seen_host_failures
         self._seen_sheds = self.router.shed_count
         self._seen_violations = self.router.slo_violations
         self._seen_failures = fails
+        self._seen_host_failures = hfails
         depth_per_replica = (
             self.router.total_queue_depth() / max(self.router.n_active, 1)
         )
         return (depth_per_replica >= self.grow_queue_depth
-                or new_sheds > 0 or new_viol > 0 or new_fails > 0)
+                or new_sheds > 0 or new_viol > 0 or new_fails > 0
+                or new_hfails > 0)
 
     def _idle(self) -> bool:
         if self.router.total_queue_depth() > 0:

@@ -184,6 +184,7 @@ class EngineDriver:
         self.steps = 0
         self.draining = False
         self.watchdog_trips = 0
+        self._last_host_poll = 0.0
         self._condemned: set[int] = set()
         self._watchdog: StepWatchdog | None = None
         if watchdog_timeout_s is not None:
@@ -306,19 +307,49 @@ class EngineDriver:
         someone else's step) or stopped answering heartbeats is contained
         here instead of waiting for traffic to trip over the corpse.
         Duck-typed — in-process engines have no ``check_health`` and cost
-        one getattr per replica. Returns whether any replica failed."""
-        failed = False
+        one getattr per replica. Returns whether any replica failed.
+
+        Host classification (remote placement): the sweep first COLLECTS
+        every failure, then groups the ones whose handles carry a
+        ``host_id``. When every live worker on a host failed in this one
+        sweep, that is host death — contained as a single batch through
+        ``router.fail_host`` (one migration wave, never onto a dying
+        sibling). A partial failure on a host stays the PR 18 per-replica
+        path. Handles without a host_id (local placements) always take
+        the per-replica path, byte-identically to before."""
+        already = set(self.router.failed_indices())
+        failures: list[tuple[int, str, str | None]] = []
         for idx, eng in enumerate(self.router.engines):
-            if idx in self.router.failed_indices():
+            if idx in already:
                 continue
             probe = getattr(eng, "check_health", None)
             if probe is None:
                 continue
             reason = probe()
             if reason is not None:
+                failures.append(
+                    (idx, reason, getattr(eng, "host_id", None))
+                )
+        if not failures:
+            return False
+        by_host: dict[str, list[tuple[int, str]]] = {}
+        for idx, reason, host in failures:
+            if host is None:
                 self._fail_replica(idx, reason)
-                failed = True
-        return failed
+            else:
+                by_host.setdefault(host, []).append((idx, reason))
+        for host, items in by_host.items():
+            live = {
+                i for i, eng in enumerate(self.router.engines)
+                if i not in already
+                and getattr(eng, "host_id", None) == host
+            }
+            if {i for i, _ in items} >= live:
+                self._fail_host(host, items[0][1])
+            else:
+                for idx, reason in items:
+                    self._fail_replica(idx, reason)
+        return True
 
     def _fail_replica(self, idx: int, reason: str) -> None:
         """Containment: eject replica ``idx`` from the fleet, migrate its
@@ -331,6 +362,21 @@ class EngineDriver:
         moved = self.router.fail_replica(idx, reason=reason)
         print(
             f"[serve] replica {idx}: {moved} request(s) migrated; "
+            f"{self.router.n_active} replica(s) active",
+            file=sys.stderr, flush=True,
+        )
+
+    def _fail_host(self, host_id: str, reason: str) -> None:
+        """Containment, host-domain edition: every worker on ``host_id``
+        goes down together, their streams migrate in one wave."""
+        print(
+            f"[serve] host {host_id} LOST ({reason}); containing its "
+            f"replicas as one batch",
+            file=sys.stderr, flush=True,
+        )
+        moved = self.router.fail_host(host_id, reason=reason)
+        print(
+            f"[serve] host {host_id}: {moved} request(s) migrated; "
             f"{self.router.n_active} replica(s) active",
             file=sys.stderr, flush=True,
         )
@@ -348,6 +394,13 @@ class EngineDriver:
         self._check_preemption()
         self._consume_inbox()
         self._check_worker_health()
+        # Quarantined-host probes are dial attempts (up to 1s each on a
+        # blackholed link), so under load they run at most every 2s —
+        # re-admission latency is bounded without stalling decode.
+        now = time.monotonic()
+        if now - self._last_host_poll >= 2.0:
+            self._last_host_poll = now
+            self.router.poll_hosts()
         self.steps += 1
         if self.xla_capture is not None:
             self.xla_capture.maybe_start(self.steps)
@@ -424,9 +477,12 @@ class EngineDriver:
             # An idle fleet still supervises its workers: a replica that
             # dies with no traffic must be replaced BEFORE the next burst,
             # so a detected failure also ticks the autoscaler (below-min
-            # replacement) without waiting for a step.
+            # replacement) without waiting for a step. The same sweep
+            # probes quarantined hosts — a healed partition re-admits the
+            # host so replacements can land there again.
             if self._check_worker_health() and self.autoscaler is not None:
                 self.autoscaler.tick()
+            self.router.poll_hosts()
             self._wake.wait(idle_wait)
             self._wake.clear()
         # Drain whatever raced in while breaking out.

@@ -114,6 +114,7 @@ class ReplicaRouter:
         # "degraded" while failures hold n_active below this.
         self.target_replicas = int(replicas)
         self.replica_failures = 0   # replicas marked FAILED, ever
+        self.host_failures = 0      # whole host domains lost, ever
         self.migrated = 0           # requests moved off failed replicas
         self._sticky: dict[bytes, int] = {}
         self._rr_next = 0
@@ -187,6 +188,41 @@ class ReplicaRouter:
         """
         if self._failed[idx]:
             return 0
+        reqs = self._eject(idx, reason)
+        return self._adopt_wave(reqs)
+
+    def fail_host(self, host_id: str, reason: str = "host death") -> int:
+        """Contain a whole host failure domain as ONE batch: every
+        not-yet-failed replica whose worker carries ``host_id`` is marked
+        FAILED *before* any migration happens — so the single adopt wave
+        below can never re-place a stream onto a sibling that is about to
+        die with the same host. The spawner (if it understands hosts) is
+        told first, quarantining the host so last-resort growth and the
+        autoscaler's replacements land on survivors only. Returns the
+        number of requests migrated."""
+        idxs = [
+            i for i, e in enumerate(self.engines)
+            if not self._failed[i]
+            and getattr(e, "host_id", None) == host_id
+        ]
+        if not idxs:
+            return 0
+        self.host_failures += 1
+        quarantine = getattr(self._make_engine, "mark_host_dead", None)
+        if quarantine is not None:
+            quarantine(host_id)
+        get_tracer().event(
+            "host_lost", host_id=host_id, replicas=idxs, reason=reason,
+            hosts_active=getattr(self._make_engine, "hosts_active", 0),
+        )
+        reqs = []
+        for i in idxs:
+            reqs.extend(self._eject(i, f"{reason} (host {host_id})"))
+        return self._adopt_wave(reqs)
+
+    def _eject(self, idx: int, reason: str) -> list:
+        """Mark one replica FAILED and pull its in-flight requests out
+        (no migration yet — callers batch the adopt wave)."""
         self._failed[idx] = True
         was_active = self._active[idx]
         self._active[idx] = False
@@ -202,6 +238,14 @@ class ReplicaRouter:
             reqs = self.engines[idx].extract_inflight()
         except Exception:
             reqs = []   # engine too corrupt even for host-side extraction
+        for req in reqs:
+            req._eject_src = idx    # labels the migrate trace event below
+        return reqs
+
+    def _adopt_wave(self, reqs: list) -> int:
+        """Re-place ejected requests onto healthy replicas as
+        recompute-prefill resumes — bit-identical continuation, zero
+        re-emitted tokens."""
         if reqs and not self.active_indices():
             try:
                 self.grow()
@@ -217,6 +261,7 @@ class ReplicaRouter:
         moved = 0
         tracer = get_tracer()
         for req in reqs:
+            src = getattr(req, "_eject_src", -1)
             active = self.active_indices()
             if not active:
                 req._finish("failed")
@@ -234,9 +279,19 @@ class ReplicaRouter:
             req.replica = dst
             self.migrated += 1
             moved += 1
-            tracer.event("migrate", rid=req.id, src=idx, dst=dst,
+            tracer.event("migrate", rid=req.id, src=src, dst=dst,
                          n_generated=len(req.generated))
         return moved
+
+    def poll_hosts(self) -> list[str]:
+        """Dial-probe quarantined hosts for re-admission (remote
+        placement; a no-op for spawners without a host concept). Returns
+        the host_ids re-admitted this call."""
+        probe = getattr(self._make_engine, "poll_hosts", None)
+        if probe is None or not getattr(self._make_engine, "dead_hosts",
+                                        None):
+            return []
+        return probe()
 
     def retire(self) -> int | None:
         """Deactivate the least-loaded active replica: no new routes land
@@ -443,6 +498,13 @@ class ReplicaRouter:
             # failure (the spawner counts them); always 0 in-process.
             "worker_restarts": float(
                 getattr(self._make_engine, "respawns", 0)
+            ),
+            # Remote placement: whole host domains lost / still serving
+            # (the RemoteSpawner tracks quarantine; 0 when the placement
+            # has no host concept).
+            "host_failures": float(self.host_failures),
+            "hosts_active": float(
+                getattr(self._make_engine, "hosts_active", 0)
             ),
         }
 

@@ -612,7 +612,7 @@ def main(argv: list[str] | None = None) -> None:
     )
 
     xla_capture = setup_observability(p, args)
-    if args.placement == "subprocess":
+    if args.placement in ("subprocess", "remote"):
         # Weights live in the workers; the HTTP process never imports jax
         # on the request path — a replica crash can't take the server down.
         config = model_config_from_args(args)
@@ -632,6 +632,14 @@ def main(argv: list[str] | None = None) -> None:
         make_engine = spawner_from_args(
             args, serve, initial_replicas=args.replicas
         )
+    elif args.placement == "remote":
+        from gpt_2_distributed_tpu.serving.frontend.worker import (
+            remote_spawner_from_args,
+        )
+
+        make_engine = remote_spawner_from_args(
+            args, serve, initial_replicas=args.replicas
+        )
     else:
         from gpt_2_distributed_tpu.serving import ServingEngine
 
@@ -646,7 +654,7 @@ def main(argv: list[str] | None = None) -> None:
             policy=args.route, ttft_slo_ms=args.ttft_slo_ms,
             queue_slo_ms=args.queue_slo_ms,
         )
-        if args.placement == "subprocess":
+        if args.placement in ("subprocess", "remote"):
             make_engine.router = router  # respawn-vs-scale-up attribution
         autoscaler = Autoscaler(
             router, min_replicas=args.min_replicas,

@@ -63,8 +63,15 @@ from gpt_2_distributed_tpu.resilience import forced_host_device_env
 from gpt_2_distributed_tpu.serving.frontend.rpc import (
     WIRE_VERSION,
     WireError,
+    client_hello,
+    create_listener,
+    describe_peer,
+    dial,
+    listener_addr,
+    load_auth_token,
     recv_msg,
     send_msg,
+    server_hello,
 )
 
 # ----------------------------------------------------------------- handle
@@ -98,22 +105,41 @@ class WorkerHandle:
 
     def __init__(
         self,
-        proc: subprocess.Popen,
+        proc: subprocess.Popen | None,
         sock: socket.socket,
         serve: ServeConfig,
         *,
         kv_pool_bytes_per_device: int = 0,
         rpc_timeout_s: float = 300.0,
         heartbeat_s: float = 1.0,
+        heartbeat_timeout_s: float | None = None,
         stats: dict | None = None,
+        host_id: str | None = None,
+        peer: str | None = None,
+        pid: int | None = None,
     ):
+        # ``proc`` is None for remote workers: the fleet owns those
+        # processes, the frontend only owns the TCP connection. A non-None
+        # ``host_id`` marks the handle as belonging to a host failure
+        # domain (only remote handles carry one — local placements keep
+        # PR 18 per-replica containment untouched).
         self.proc = proc
-        self.pid = proc.pid
+        self.pid = proc.pid if proc is not None else pid
+        self.host_id = host_id
+        self.peer = peer or describe_peer(sock)
+        self._label = (f"pid={self.pid}" if proc is not None
+                       else f"{self.peer} (host {host_id or '?'})")
         self._sock = sock
         self.serve = serve
         self.kv_pool_bytes_per_device = int(kv_pool_bytes_per_device)
         self.rpc_timeout_s = float(rpc_timeout_s)
         self.heartbeat_s = float(heartbeat_s)
+        # Satellite: the heartbeat reply deadline is a flag now — a
+        # cross-host budget must not be derived from local-socket cadence.
+        self.heartbeat_timeout_s = (
+            float(heartbeat_timeout_s) if heartbeat_timeout_s is not None
+            else max(self.heartbeat_s * 5.0, 2.0)
+        )
         self._dead: str | None = None
         self._inflight: dict[int, object] = {}  # rid -> mirror RequestHandle
         self._stats: dict = dict(stats or {})
@@ -136,7 +162,11 @@ class WorkerHandle:
         except OSError:
             pass
         # Reap the process whatever state it is in — SIGKILL also moves a
-        # SIGSTOPped worker along, so a frozen child never lingers.
+        # SIGSTOPped worker along, so a frozen child never lingers. Remote
+        # workers have no local process: dropping the connection is the
+        # whole containment (the fleet supervises the process itself).
+        if self.proc is None:
+            return
         if self.proc.poll() is None:
             try:
                 self.proc.kill()
@@ -153,13 +183,13 @@ class WorkerHandle:
         late, so the handle is marked dead rather than risking a stale
         frame being read as the next call's reply."""
         if self._dead is not None:
-            raise WireError(f"worker pid={self.pid} is dead: {self._dead}")
+            raise WireError(f"worker {self._label} is dead: {self._dead}")
         self._sock.settimeout(
             self.rpc_timeout_s if timeout is None else timeout
         )
         try:
-            send_msg(self._sock, obj)
-            reply = recv_msg(self._sock)
+            send_msg(self._sock, obj, peer=self.peer)
+            reply = recv_msg(self._sock, peer=self.peer)
         except WireError as e:
             self._mark_dead(f"rpc {obj.get('op')!r} failed: {e}")
             raise
@@ -169,7 +199,7 @@ class WorkerHandle:
             if reply.get("error_type") == "ValueError":
                 raise ValueError(err)
             raise RuntimeError(
-                f"worker pid={self.pid} {obj.get('op')!r}: {err}"
+                f"worker {self._label} {obj.get('op')!r}: {err}"
             )
         return reply
 
@@ -340,15 +370,18 @@ class WorkerHandle:
         (active stepping refreshes ``_last_rpc`` constantly)."""
         if self._dead is not None:
             return self._dead
-        rc = self.proc.poll()
-        if rc is not None:
-            self._mark_dead(f"worker exit rc={rc}")
-            return self._dead
+        if self.proc is not None:
+            rc = self.proc.poll()
+            if rc is not None:
+                self._mark_dead(f"worker exit rc={rc}")
+                return self._dead
         if time.monotonic() - self._last_rpc < self.heartbeat_s:
             return None
         if not self._heartbeat():
+            extra = {"host_id": self.host_id} if self.host_id else {}
             get_tracer().event(
                 "heartbeat_loss", ts=time.monotonic(), pid=self.pid,
+                **extra,
             )
             self._mark_dead("heartbeat loss")
             return self._dead
@@ -359,7 +392,7 @@ class WorkerHandle:
         number, so a reply that arrives after its attempt timed out is
         recognizably stale and drained by the next attempt instead of
         desyncing the stream (the only RPC where a late reply is safe)."""
-        timeout = max(self.heartbeat_s * 5.0, 2.0)
+        timeout = self.heartbeat_timeout_s
         for _ in range(attempts):
             self._hb_seq += 1
             want = self._hb_seq
@@ -380,10 +413,20 @@ class WorkerHandle:
 
     def kill(self, sig: int = signal.SIGKILL) -> None:
         """Deliver a real signal to the worker process (chaos bench)."""
+        if self.proc is None:
+            raise RuntimeError(
+                f"worker {self._label} is remote — no local process to "
+                "signal (use the network-chaos proxy instead)"
+            )
         os.kill(self.pid, sig)
 
     def close(self) -> None:
-        """Graceful shutdown: ask, wait, then escalate."""
+        """Graceful shutdown: ask, wait, then escalate. A remote worker
+        is only *disconnected* — its process belongs to the fleet and
+        keeps listening for the next frontend."""
+        if self.proc is None:
+            self._mark_dead("closed")
+            return
         if self._dead is None:
             try:
                 self._rpc({"op": "shutdown"}, timeout=10.0)
@@ -424,7 +467,9 @@ class WorkerSpawner:
         respawn_backoff_s: float = 2.0,
         rpc_timeout_s: float = 300.0,
         heartbeat_s: float = 1.0,
+        heartbeat_timeout_s: float | None = None,
         connect_timeout_s: float = 120.0,
+        auth_token: bytes | None = None,
         env: dict | None = None,
     ):
         self.argv = list(argv)
@@ -434,7 +479,9 @@ class WorkerSpawner:
         self.respawn_backoff_s = float(respawn_backoff_s)
         self.rpc_timeout_s = float(rpc_timeout_s)
         self.heartbeat_s = float(heartbeat_s)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
         self.connect_timeout_s = float(connect_timeout_s)
+        self.auth_token = auth_token
         self.env = env
         self.router = None          # attached by the owner post-construction
         self.spawns = 0
@@ -491,7 +538,9 @@ class WorkerSpawner:
             kv_pool_bytes_per_device=hello["kv_pool_bytes_per_device"],
             rpc_timeout_s=self.rpc_timeout_s,
             heartbeat_s=self.heartbeat_s,
+            heartbeat_timeout_s=self.heartbeat_timeout_s,
             stats=hello.get("stats"),
+            peer=path,
         )
 
     def _connect(self, proc: subprocess.Popen,
@@ -523,16 +572,7 @@ class WorkerSpawner:
 
     def _hello(self, sock: socket.socket) -> dict:
         sock.settimeout(self.connect_timeout_s)
-        send_msg(sock, {"op": "hello", "wire_version": WIRE_VERSION})
-        reply = recv_msg(sock)
-        if not reply.get("ok"):
-            raise RuntimeError(f"worker hello failed: {reply.get('error')}")
-        if reply.get("wire_version") != WIRE_VERSION:
-            raise RuntimeError(
-                f"worker speaks wire version {reply.get('wire_version')}, "
-                f"frontend speaks {WIRE_VERSION} — mixed builds"
-            )
-        return reply
+        return client_hello(sock, self.auth_token)
 
 
 def worker_argv(args: argparse.Namespace, serve: ServeConfig) -> list[str]:
@@ -574,6 +614,11 @@ def worker_argv(args: argparse.Namespace, serve: ServeConfig) -> list[str]:
                  "--trace_max_file_bytes", str(args.trace_max_file_bytes)]
     if getattr(args, "device", None):
         argv += ["--device", args.device]
+    if getattr(args, "worker_auth_token_file", None):
+        # Same handshake over AF_UNIX as over TCP: a token-bearing
+        # frontend refuses ANY unauthenticated worker, so spawned
+        # children must authenticate too.
+        argv += ["--auth_token_file", args.worker_auth_token_file]
     return argv
 
 
@@ -594,6 +639,7 @@ def spawner_from_args(
         env = forced_host_device_env(serve.mesh_devices)
         if getattr(args, "device", None):
             env["JAX_PLATFORMS"] = args.device
+    token_file = getattr(args, "worker_auth_token_file", None)
     return WorkerSpawner(
         worker_argv(args, serve), serve,
         initial_replicas=initial_replicas,
@@ -601,8 +647,226 @@ def spawner_from_args(
         respawn_backoff_s=args.worker_respawn_backoff_s,
         rpc_timeout_s=args.worker_rpc_timeout_s,
         heartbeat_s=args.worker_heartbeat_s,
+        heartbeat_timeout_s=getattr(args, "worker_heartbeat_timeout_s",
+                                    None),
         connect_timeout_s=args.worker_connect_timeout_s,
+        auth_token=load_auth_token(token_file) if token_file else None,
         env=env,
+    )
+
+
+# --------------------------------------------------------- remote spawner
+
+
+def read_worker_pool(path: str) -> list[dict]:
+    """Parse a worker-pool file: one ``host_id address`` pair per line
+    (``#`` comments and blanks skipped). Workers append their own line
+    via ``gpt2-tpu-worker --advertise FILE`` after binding, so the file
+    doubles as a registration ledger. Duplicate addresses collapse to
+    the last-registered host_id."""
+    entries, seen = [], {}
+    with open(path) as f:
+        for ln, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(
+                    f"{path}:{ln}: expected 'host_id address', got "
+                    f"{line!r}"
+                )
+            host_id, addr = parts
+            if addr in seen:
+                seen[addr]["host_id"] = host_id
+                continue
+            entry = {"host_id": host_id, "addr": addr, "handle": None}
+            seen[addr] = entry
+            entries.append(entry)
+    if not entries:
+        raise ValueError(f"worker pool file {path} names no workers")
+    return entries
+
+
+class RemoteSpawner:
+    """``make_engine`` for remote placement: each call ADOPTS one
+    pre-started TCP worker from the ``--worker_pool`` fleet rather than
+    spawning a process — the fleet owns worker lifecycles, the frontend
+    owns connections. Respawn accounting (budget, exponential backoff,
+    give-up-loudly) is identical to :class:`WorkerSpawner`; what differs
+    is *placement*: replacements land on surviving hosts only, and a
+    host the driver declared dead stays quarantined until a dial probe
+    (``poll_hosts``) reaches it again, which re-admits the whole host
+    with a ``host_joined`` trace event."""
+
+    def __init__(
+        self,
+        pool: list[dict],
+        serve: ServeConfig,
+        *,
+        initial_replicas: int = 1,
+        max_respawns: int = 3,
+        respawn_backoff_s: float = 2.0,
+        rpc_timeout_s: float = 300.0,
+        heartbeat_s: float = 1.0,
+        heartbeat_timeout_s: float | None = None,
+        connect_timeout_s: float = 120.0,
+        auth_token: bytes | None = None,
+    ):
+        self.pool = pool
+        self.serve = serve
+        self.initial_replicas = int(initial_replicas)
+        self.max_respawns = int(max_respawns)
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.auth_token = auth_token
+        self.router = None          # attached by the owner post-construction
+        self.spawns = 0
+        self.respawns = 0           # -> router metric "worker_restarts"
+        self.dead_hosts: set[str] = set()
+
+    # --------------------------------------------------- host quarantine
+
+    def mark_host_dead(self, host_id: str) -> None:
+        self.dead_hosts.add(host_id)
+
+    def readmit(self, host_id: str) -> None:
+        self.dead_hosts.discard(host_id)
+
+    def poll_hosts(self) -> list[str]:
+        """Dial-probe every quarantined host; a host whose worker accepts
+        a TCP connection again is re-admitted (eligible for placement on
+        the next grow). Returns the re-admitted host_ids."""
+        rejoined = []
+        for host_id in sorted(self.dead_hosts):
+            for entry in self.pool:
+                if entry["host_id"] != host_id:
+                    continue
+                try:
+                    probe = dial(entry["addr"], timeout=1.0)
+                    probe.close()
+                except OSError:
+                    continue
+                self.readmit(host_id)
+                rejoined.append(host_id)
+                get_tracer().event("host_joined", ts=time.monotonic(),
+                                   host_id=host_id)
+                print(f"[remote-spawner] host {host_id} reachable again "
+                      f"— re-admitted", file=sys.stderr)
+                break
+        return rejoined
+
+    @property
+    def hosts_active(self) -> int:
+        all_hosts = {e["host_id"] for e in self.pool}
+        return len(all_hosts - self.dead_hosts)
+
+    # -------------------------------------------------------- make_engine
+
+    def _is_respawn(self) -> bool:
+        if self.router is not None:
+            return getattr(self.router, "n_failed", 0) > self.respawns
+        return self.spawns >= self.initial_replicas
+
+    def _free_entries(self) -> list[dict]:
+        return [
+            e for e in self.pool
+            if e["host_id"] not in self.dead_hosts
+            and (e["handle"] is None or e["handle"]._dead is not None)
+        ]
+
+    def __call__(self) -> WorkerHandle:
+        tracer = get_tracer()
+        if self._is_respawn():
+            n = self.respawns + 1
+            if n > self.max_respawns:
+                raise RuntimeError(
+                    f"worker respawn budget exhausted: {self.respawns} "
+                    f"respawns used of --worker_max_respawns="
+                    f"{self.max_respawns} — fleet degrades, giving up on "
+                    f"replacement (supervise.sh semantics)"
+                )
+            backoff = self.respawn_backoff_s * (2.0 ** (n - 1))
+            tracer.event("worker_respawn", ts=time.monotonic(),
+                         respawn=n, backoff_s=backoff)
+            print(f"[remote-spawner] respawn {n}/{self.max_respawns} "
+                  f"after {backoff:.1f}s backoff "
+                  f"(dead hosts: {sorted(self.dead_hosts) or 'none'})",
+                  file=sys.stderr)
+            if backoff > 0:
+                time.sleep(backoff)
+            self.respawns = n
+        errors = []
+        for entry in self._free_entries():
+            try:
+                handle = self._adopt(entry)
+            except (OSError, WireError, RuntimeError) as e:
+                errors.append(f"{entry['addr']}: {e}")
+                continue
+            entry["handle"] = handle
+            self.spawns += 1
+            tracer.event("worker_spawn", ts=time.monotonic(),
+                         pid=handle.pid, spawn=self.spawns,
+                         respawn=self.respawns,
+                         host_id=entry["host_id"], addr=entry["addr"])
+            return handle
+        detail = "; ".join(errors) if errors else "every entry is in use"
+        raise RuntimeError(
+            f"no adoptable worker in the pool "
+            f"({self.hosts_active} hosts active, "
+            f"{len(self.dead_hosts)} quarantined): {detail}"
+        )
+
+    def _adopt(self, entry: dict) -> WorkerHandle:
+        sock = dial(entry["addr"], timeout=self.connect_timeout_s)
+        try:
+            hello = client_hello(sock, self.auth_token, peer=entry["addr"])
+        except WireError:
+            sock.close()
+            raise
+        serve = ServeConfig(**hello["serve"])
+        if serve != self.serve:
+            sock.close()
+            raise RuntimeError(
+                f"worker at {entry['addr']} built a different ServeConfig "
+                f"than the frontend expected: {serve} != {self.serve}"
+            )
+        return WorkerHandle(
+            None, sock, serve,
+            kv_pool_bytes_per_device=hello["kv_pool_bytes_per_device"],
+            rpc_timeout_s=self.rpc_timeout_s,
+            heartbeat_s=self.heartbeat_s,
+            heartbeat_timeout_s=self.heartbeat_timeout_s,
+            stats=hello.get("stats"),
+            host_id=entry["host_id"],
+            peer=entry["addr"],
+            pid=hello.get("pid"),
+        )
+
+
+def remote_spawner_from_args(
+    args: argparse.Namespace,
+    serve: ServeConfig,
+    *,
+    initial_replicas: int = 1,
+) -> RemoteSpawner:
+    """The shared constructor for ``--placement remote``: pool file +
+    the same supervision knobs as subprocess placement."""
+    token_file = getattr(args, "worker_auth_token_file", None)
+    return RemoteSpawner(
+        read_worker_pool(args.worker_pool), serve,
+        initial_replicas=initial_replicas,
+        max_respawns=args.worker_max_respawns,
+        respawn_backoff_s=args.worker_respawn_backoff_s,
+        rpc_timeout_s=args.worker_rpc_timeout_s,
+        heartbeat_s=args.worker_heartbeat_s,
+        heartbeat_timeout_s=getattr(args, "worker_heartbeat_timeout_s",
+                                    None),
+        connect_timeout_s=args.worker_connect_timeout_s,
+        auth_token=load_auth_token(token_file) if token_file else None,
     )
 
 
@@ -720,31 +984,39 @@ def _dispatch(state: _WorkerState, msg: dict) -> tuple[dict, bool]:
             "error": f"unknown op {op!r}"}, True
 
 
-def _serve_loop(conn: socket.socket, state: _WorkerState) -> None:
+def _serve_loop(conn: socket.socket, state: _WorkerState,
+                token: bytes | None = None) -> None:
+    peer = describe_peer(conn)
     while True:
         try:
-            msg = recv_msg(conn)
+            msg = recv_msg(conn, peer=peer)
         except WireError:
             return  # frontend gone: nothing left to serve
         if msg.get("op") == "hello":
-            if msg.get("wire_version") != WIRE_VERSION:
-                send_msg(conn, {
-                    "ok": False, "error_type": "WireError",
-                    "error": f"wire version mismatch: frontend "
-                             f"{msg.get('wire_version')}, worker "
-                             f"{WIRE_VERSION}",
-                })
+            # Version check, then (token given) mutual HMAC challenge.
+            # On refusal server_hello has already sent the error frame —
+            # drop the connection with NO engine payload sent.
+            if not server_hello(conn, msg, token, peer=peer):
+                print(f"[worker pid={os.getpid()}] refused hello from "
+                      f"{peer} (bad version or failed authentication)",
+                      file=sys.stderr)
                 return
             eng = state.engine
             import dataclasses
 
-            send_msg(conn, {
-                "ok": True, "wire_version": WIRE_VERSION,
-                "pid": os.getpid(),
-                "serve": dataclasses.asdict(eng.serve),
-                "kv_pool_bytes_per_device": eng.kv_pool_bytes_per_device,
-                "stats": eng.stats,
-            })
+            try:
+                send_msg(conn, {
+                    "ok": True, "wire_version": WIRE_VERSION,
+                    "pid": os.getpid(),
+                    "serve": dataclasses.asdict(eng.serve),
+                    "kv_pool_bytes_per_device": eng.kv_pool_bytes_per_device,
+                    "stats": eng.stats,
+                }, peer=peer)
+            except WireError:
+                # Peer vanished (or the link was cut) mid-handshake: a
+                # fleet worker survives its clients — drop the connection,
+                # never the process.
+                return
             continue
         try:
             reply, keep = _dispatch(state, msg)
@@ -770,10 +1042,25 @@ def build_argparser() -> argparse.ArgumentParser:
 
     p = argparse.ArgumentParser(
         description="serving replica worker: one ServingEngine behind a "
-                    "Unix-socket RPC (spawned by the frontend, not run "
-                    "by hand)")
+                    "length-prefixed JSON RPC. Spawned by the frontend "
+                    "over a Unix socket (--placement subprocess) or run "
+                    "standalone listening on tcp://host:port for a "
+                    "--placement remote frontend to adopt")
     p.add_argument("--socket", required=True,
-                   help="Unix socket path to bind and serve RPC on")
+                   help="address to bind and serve RPC on: a Unix socket "
+                        "path, or tcp://host:port (port 0 = ephemeral; "
+                        "pair with --advertise)")
+    p.add_argument("--auth_token_file", default=None,
+                   help="shared-secret file: require every frontend to "
+                        "pass the mutual HMAC challenge-response at "
+                        "hello before any engine state moves")
+    p.add_argument("--host_id", default=None,
+                   help="failure-domain label reported to the fleet "
+                        "(default: this machine's hostname)")
+    p.add_argument("--advertise", default=None, metavar="FILE",
+                   help="append 'host_id address' to FILE after binding "
+                        "— registers this worker in a --worker_pool "
+                        "ledger (resolves a port-0 bind)")
     add_model_flags(p)
     add_engine_flags(p)
     add_obs_flags(p)
@@ -788,15 +1075,25 @@ def main(argv: list[str] | None = None) -> None:
     if args.device:
         os.environ["JAX_PLATFORMS"] = args.device
 
+    token = (load_auth_token(args.auth_token_file)
+             if args.auth_token_file else None)
+
     # Bind + listen BEFORE the jax import: the parent's connect succeeds
     # (backlog) while the engine is still building, and its generous hello
     # timeout covers the build. An orphaned socket file from a previous
-    # incarnation is stale by construction — the spawner never reuses paths.
-    if os.path.exists(args.socket):
-        os.unlink(args.socket)
-    lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    lsock.bind(args.socket)
-    lsock.listen(1)
+    # incarnation is stale by construction — the spawner never reuses
+    # paths, and TCP listeners set SO_REUSEADDR. Advertise only after the
+    # bind so the ledger never names an address that was never live (and
+    # a port-0 bind resolves to its real port).
+    is_tcp = args.socket.startswith("tcp://")
+    lsock = create_listener(args.socket, backlog=8 if is_tcp else 1)
+    bound = listener_addr(lsock) if is_tcp else args.socket
+    if args.advertise:
+        host_id = args.host_id or socket.gethostname()
+        with open(args.advertise, "a") as f:
+            f.write(f"{host_id} {bound}\n")
+        print(f"[worker pid={os.getpid()}] advertised {host_id} {bound} "
+              f"in {args.advertise}", file=sys.stderr)
 
     from gpt_2_distributed_tpu.obs.trace import configure_tracing
     from gpt_2_distributed_tpu.serving import ServingEngine
@@ -812,18 +1109,41 @@ def main(argv: list[str] | None = None) -> None:
     serve = build_serve_config(args, config)
     engine = ServingEngine(params, config, serve,
                            temperature=args.temperature, top_k=args.top_k)
-    print(f"[worker pid={os.getpid()}] engine ready "
+    print(f"[worker pid={os.getpid()}] engine ready on {bound} "
           f"(mesh={serve.mesh or 'single'}, devices={serve.mesh_devices})",
           file=sys.stderr)
 
-    conn, _ = lsock.accept()
     try:
-        _serve_loop(conn, _WorkerState(engine))
+        while True:
+            conn, _ = lsock.accept()
+            try:
+                _serve_loop(conn, _WorkerState(engine), token)
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            if not is_tcp:
+                # Unix placement: the spawner owns this process; its
+                # disconnect IS the shutdown (PR 18 semantics).
+                return
+            # TCP fleet worker: the frontend is gone (partition, frontend
+            # restart, or a refused hello) but the process belongs to the
+            # fleet — drop any orphaned in-flight state so the next
+            # frontend adopts a clean engine (the old frontend already
+            # migrated those streams from its mirrors), and keep
+            # listening.
+            orphans = engine.extract_inflight()
+            if orphans:
+                print(f"[worker pid={os.getpid()}] dropped "
+                      f"{len(orphans)} orphaned streams after "
+                      f"disconnect; listening again on {bound}",
+                      file=sys.stderr)
     finally:
         try:
-            conn.close()
             lsock.close()
-            os.unlink(args.socket)
+            if not is_tcp:
+                os.unlink(args.socket)
         except OSError:
             pass
         get_tracer().close()

@@ -127,11 +127,15 @@ def add_placement_flags(p: argparse.ArgumentParser) -> None:
     """Replica placement + worker supervision flags, shared by the JSONL
     CLI, the HTTP front end and the chaos bench. Validated jax-free via
     ``config.validate_worker_flags``."""
+    from gpt_2_distributed_tpu.config import PLACEMENTS
+
     p.add_argument("--placement", default="inprocess",
-                   choices=["inprocess", "subprocess"],
+                   choices=list(PLACEMENTS),
                    help="replica placement: engines inside this process "
-                        "(default), or one worker process per replica "
-                        "behind the RPC supervision plane")
+                        "(default), one worker process per replica behind "
+                        "the RPC supervision plane, or remote workers "
+                        "adopted over authenticated TCP from a "
+                        "--worker_pool fleet")
     p.add_argument("--worker_max_respawns", type=int, default=3,
                    help="replacement workers spawned after failures before "
                         "the fleet degrades loudly (supervise.sh "
@@ -149,6 +153,22 @@ def add_placement_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--worker_connect_timeout_s", type=float, default=120.0,
                    help="worker spawn-to-hello deadline (covers the "
                         "child's jax import + engine build)")
+    p.add_argument("--worker_heartbeat_timeout_s", type=float, default=None,
+                   help="per-attempt heartbeat reply deadline; default "
+                        "derives max(5 x --worker_heartbeat_s, 2.0) — set "
+                        "explicitly for cross-host fleets, where the "
+                        "heartbeat budget should not be derived from the "
+                        "local-socket cadence")
+    p.add_argument("--worker_auth_token_file", default=None,
+                   help="shared-secret file for the worker hello's mutual "
+                        "HMAC challenge-response; unauthenticated or "
+                        "wrong-token peers are refused before any engine "
+                        "state moves (give workers the same file via "
+                        "--auth_token_file)")
+    p.add_argument("--worker_pool", default=None,
+                   help="--placement remote: file of 'host_id address' "
+                        "lines naming the worker fleet (workers append "
+                        "themselves with gpt2-tpu-worker --advertise)")
 
 
 def add_fault_flags(p: argparse.ArgumentParser) -> None:
@@ -352,7 +372,7 @@ def main(argv: list[str] | None = None) -> None:
     from gpt_2_distributed_tpu.serving.frontend.router import ReplicaRouter
 
     xla_capture = setup_observability(p, args)
-    if args.placement == "subprocess":
+    if args.placement in ("subprocess", "remote"):
         # The frontend stays off the device: weights load inside the
         # worker processes; the parent only needs the model SHAPE for
         # pool sizing and prompt validation.
@@ -407,6 +427,13 @@ def main(argv: list[str] | None = None) -> None:
         )
 
         make_engine = spawner_from_args(args, serve, initial_replicas=1)
+    elif args.placement == "remote":
+        from gpt_2_distributed_tpu.serving.frontend.worker import (
+            remote_spawner_from_args,
+        )
+
+        make_engine = remote_spawner_from_args(args, serve,
+                                               initial_replicas=1)
     else:
         from gpt_2_distributed_tpu.serving import ServingEngine
 
@@ -415,7 +442,7 @@ def main(argv: list[str] | None = None) -> None:
                                  temperature=args.temperature,
                                  top_k=args.top_k)
     router = ReplicaRouter(make_engine, replicas=1)
-    if args.placement == "subprocess":
+    if args.placement in ("subprocess", "remote"):
         make_engine.router = router  # respawn-vs-scale-up attribution
     tracker = make_tracker(args)
     # SIGTERM = finish what was accepted, exit 0. Every request below is

@@ -38,8 +38,9 @@ from gpt_2_distributed_tpu.data.dataloader import (
     DEFAULT_PREFETCH_FACTOR,
     TokenShardDataset,
     create_dataloader,
+    cursor_plan_digest,
     get_shard_paths,
-    plan_cursor_migration,
+    replay_cursor_history,
 )
 
 DEFAULT_SEED = 42  # reference global seed, /root/reference/train_gpt2_distributed.py:39
@@ -880,7 +881,7 @@ def main(argv: list[str] | None = None) -> None:
         # window plan instead of the arithmetic prefix skip. cursor_base is
         # the optimizer-step count that plan already accounts for in epoch
         # cursor_epoch — the loader skips only steps taken SINCE the resize.
-        cursor_base, cursor_epoch = 0, -1
+        cursor_base, cursor_epoch, cursor_record = 0, -1, None
         if args.resume and args.save_dir:
             # Prune stale uncommitted dirs (a crash mid-async-save leaves one)
             # and apply retention before picking a restore candidate.
@@ -936,6 +937,11 @@ def main(argv: list[str] | None = None) -> None:
                     "process_count", "workers", "local_batch",
                     "grad_accum_steps",
                 )
+                prior = getattr(meta, "cursor_plan", None)
+                if prior and int(prior.get("epoch", -1)) != meta.epoch:
+                    # The partially-consumed epoch finished; its history
+                    # is settled and carries nothing into this one.
+                    prior = None
                 if skip_steps > 0 and all(k in mw for k in needed):
                     old_shape = (
                         int(mw["process_count"]), int(mw["workers"]),
@@ -944,22 +950,63 @@ def main(argv: list[str] | None = None) -> None:
                     new_shape = (
                         jax.process_count(), dataset.num_workers, local_batch,
                     )
-                    if old_shape != new_shape:
-                        plan = plan_cursor_migration(
-                            shard_paths,
-                            seq_len=args.seq_len,
-                            epoch=meta.epoch,
-                            old_process_count=old_shape[0],
-                            old_num_workers=old_shape[1],
-                            old_batch_size=old_shape[2],
-                            consumed_batches=(
-                                skip_steps * int(mw["grad_accum_steps"])
-                            ),
+                    # A prior record forces the migration path even at an
+                    # unchanged shape: the restored world trained on a
+                    # plan's complement, so the arithmetic prefix skip
+                    # would replay the wrong stream.
+                    if old_shape != new_shape or prior is not None:
+                        resizes = list(prior["resizes"]) if prior else []
+                        resizes.append({
+                            "process_count": old_shape[0],
+                            "workers": old_shape[1],
+                            "local_batch": old_shape[2],
+                            "grad_accum_steps": int(mw["grad_accum_steps"]),
+                            "steps": skip_steps,
+                        })
+                        if prior is not None:
+                            # Second same-epoch resize: recompute the plan
+                            # the previous resume persisted and verify the
+                            # digest — exactness proven, or fail loudly.
+                            base = replay_cursor_history(
+                                shard_paths, seq_len=args.seq_len,
+                                epoch=meta.epoch, resizes=resizes[:-1],
+                            )
+                            got = cursor_plan_digest(base)
+                            if got != prior["digest"]:
+                                raise SystemExit(
+                                    f"error: elastic resume: the consumed-"
+                                    f"window plan persisted at the previous "
+                                    f"same-epoch resize (digest "
+                                    f"{prior['digest'][:12]}..., "
+                                    f"{prior.get('windows')} windows) does "
+                                    f"not reproduce from the current shards "
+                                    f"(digest {got[:12]}...) — the data "
+                                    f"files changed under a half-consumed "
+                                    f"epoch, so the exact resume cursor is "
+                                    f"unrecoverable; restart the epoch or "
+                                    f"restore the original shards"
+                                )
+                            if is_primary():
+                                print(
+                                    f"[elastic] prior cursor plan verified "
+                                    f"(digest {got[:12]}..., "
+                                    f"{len(resizes) - 1} earlier resize(s) "
+                                    f"this epoch)"
+                                )
+                        plan = replay_cursor_history(
+                            shard_paths, seq_len=args.seq_len,
+                            epoch=meta.epoch, resizes=resizes,
                         )
                         dataset.set_consumed(plan, epoch=meta.epoch)
                         cursor_base, cursor_epoch = skip_steps, meta.epoch
+                        n_win = sum(len(v) for v in plan.values())
+                        cursor_record = {
+                            "epoch": meta.epoch,
+                            "digest": cursor_plan_digest(plan),
+                            "windows": n_win,
+                            "resizes": resizes,
+                        }
                         if is_primary():
-                            n_win = sum(len(v) for v in plan.values())
                             print(
                                 f"[elastic] data cursor migrated: old world "
                                 f"(processes={old_shape[0]}, "
@@ -1012,6 +1059,11 @@ def main(argv: list[str] | None = None) -> None:
                 total_tokens=tracker.total_tokens,
                 spike_monitor=monitor.state_dict() if monitor else None,
                 world=world_record,
+                # The same-epoch resize history travels with every
+                # checkpoint of the partially-consumed epoch; once a new
+                # epoch starts the stream is virgin again and the record
+                # is dropped.
+                cursor_plan=(cursor_record if ep == cursor_epoch else None),
             )
 
         # --- evaluation -------------------------------------------------------

@@ -72,6 +72,19 @@ A/B (in-process vs subprocess clean replays) and bit-parity verdicts
 for greedy and sampled decoding; exits nonzero on no-fire, divergence,
 or any re-emitted token.
 
+``--chaos --chaos_net {partition,torn,slow,blackhole}`` is the
+cross-host proof: the bench provisions its OWN remote fleet — real TCP
+worker processes with authenticated hellos, two host failure domains,
+and an in-path chaos proxy on every link — then injures the victim
+host's links mid-decode (hard partition, torn frame mid-header,
+injected latency, one-way blackhole). The supervision plane must
+contain the whole host as ONE batch (``fail_host``), migrate every
+stream with zero re-emission, and re-admit the host after ``heal()``.
+Merges a ``chaos_net`` record (keyed by injury mode) carrying the
+TCP-hop A/B and bit-parity verdicts for greedy and sampled decoding;
+exits nonzero on no-fire, divergence, re-emission, or any failed
+stream.
+
 Flag combos the bench can't honor are refused at parse time (mirroring
 bench.py's --suite rejection), before any jax import.
 """
@@ -239,6 +252,20 @@ def build_argparser() -> argparse.ArgumentParser:
                    "'sigstop' send the REAL signal to a subprocess "
                    "worker's pid (needs --placement subprocess) — "
                    "supervision must detect the corpse/stall itself")
+    # Network chaos (PR 19): the bench provisions its OWN remote fleet —
+    # real TCP workers behind per-link chaos proxies — so no --placement
+    # or --worker_pool is needed (or accepted) here.
+    p.add_argument("--chaos_net", default=None,
+                   choices=["partition", "torn", "slow", "blackhole"],
+                   help="network-chaos mode (needs --chaos): replay the "
+                   "seeded trace through authenticated TCP workers behind "
+                   "in-path chaos proxies, injure the victim HOST's links "
+                   "mid-decode (hard partition / torn frame mid-header / "
+                   "injected latency / one-way blackhole), and verify "
+                   "host-death batch migration kept every stream "
+                   "bit-identical to the in-process reference with zero "
+                   "re-emitted tokens; merges a 'chaos_net' record keyed "
+                   "by mode into --json")
     p.add_argument("--json", default="BENCH_SERVE.json", metavar="PATH",
                    help="result file ('' disables the write); front-door "
                    "and chaos modes merge their record into an existing "
@@ -368,6 +395,26 @@ def validate_args(p: argparse.ArgumentParser, args: argparse.Namespace) -> None:
                     "--chaos_kill (+ optional --inject_replica_fail_at "
                     "for the trigger step); drop --inject_replica_hang_at"
                     "/--inject_step_exception")
+        if args.chaos_net is not None:
+            p.error("--chaos_net provisions its own TCP fleet behind "
+                    "chaos proxies; drop --placement subprocess")
+    if args.placement == "remote":
+        p.error("--placement remote: the bench reaches remote TCP workers "
+                "through --chaos_net, which provisions its own fleet "
+                "(workers + chaos proxies + pool file); drop --placement")
+    if args.chaos_net is not None:
+        if not args.chaos:
+            p.error("--chaos_net replays the closed chaos trace; it needs "
+                    "--chaos")
+        if args.chaos_kill != "exception":
+            p.error(f"--chaos_kill {args.chaos_kill} signals a LOCAL "
+                    "process; --chaos_net injures the network — pick one")
+        if (args.hang_spec is not None
+                or args.inject_step_exception is not None):
+            p.error("--chaos_net is driven by the network injury "
+                    "(+ optional --inject_replica_fail_at for the trigger "
+                    "step); drop --inject_replica_hang_at/"
+                    "--inject_step_exception")
     any_inject = (args.fail_spec is not None or args.hang_spec is not None
                   or args.inject_step_exception is not None)
     if args.chaos:
@@ -1113,6 +1160,372 @@ def run_chaos_proc(args, params, config, serve, jax, np):
     return out
 
 
+def run_chaos_net(args, params, config, serve, jax, np):
+    """Cross-host network chaos (``--chaos_net``): the seeded closed trace
+    replayed through REAL TCP workers — authenticated hello, host_ids,
+    pool-file adoption — with every link routed through an in-path
+    :class:`ChaosProxy` and the victim HOST's links injured mid-decode.
+
+    Per temperature (greedy and sampled) the bench provisions one fleet of
+    ``2 * replicas`` worker processes — ``replicas`` on victim host ``h0``,
+    ``replicas`` spares on survivor ``h1`` — and runs three replays:
+
+    1. ``inprocess`` — the PR 16 reference streams.
+    2. ``remote`` — a clean TCP fleet adopted from a direct pool file:
+       the TCP-vs-in-process RPC A/B (PERF_ANALYSIS §20 prices the hop
+       against chaos_proc's Unix-socket number).
+    3. ``remote_chaos`` — the same workers behind chaos proxies; at the
+       trigger step BOTH of h0's links take the injury at once, so the
+       health sweep sees every worker on the host fail inside one window
+       and must contain the whole failure domain as a batch
+       (``fail_host``): one extract->adopt wave onto h1, zero re-emitted
+       tokens, and — once the links heal — a dial-probe re-admission of
+       h0 (``host_joined``).
+
+    Every stream in every replay must match the in-process reference
+    bit-for-bit; main() exits nonzero on no-fire, divergence, any
+    re-emission, or any failed stream — a committed ``chaos_net`` record
+    IS the proof.
+    """
+    import copy
+    import shutil
+    import subprocess
+    import tempfile
+
+    from gpt_2_distributed_tpu.resilience import forced_host_device_env
+    from gpt_2_distributed_tpu.serving import ServingEngine
+    from gpt_2_distributed_tpu.serving.frontend.autoscale import Autoscaler
+    from gpt_2_distributed_tpu.serving.frontend.driver import EngineDriver
+    from gpt_2_distributed_tpu.serving.frontend.netchaos import ChaosProxy
+    from gpt_2_distributed_tpu.serving.frontend.router import ReplicaRouter
+    from gpt_2_distributed_tpu.serving.frontend.rpc import (
+        WireError,
+        client_hello,
+        dial,
+        load_auth_token,
+    )
+    from gpt_2_distributed_tpu.serving.frontend.worker import (
+        read_worker_pool,
+        remote_spawner_from_args,
+        worker_argv,
+    )
+
+    shared = args.traces != "original"
+    trace = make_trace(args, np, config.vocab_size, shared=shared)
+    arrivals, prompts, news, meta = trace
+    n = len(prompts)
+    keys = [jax.random.PRNGKey(args.trace_seed * 100_000 + i)
+            for i in range(n)]
+    kill_step, _ = args.fail_spec
+    mode = args.chaos_net
+
+    def fleet_args(temp):
+        """Frontend/worker flag set shared by every replay of one fleet:
+        seeded init weights, tight heartbeat cadence so failure detection
+        happens in the health sweep (where host-death classification
+        lives), and the PR 19 satellite knob exercised for real."""
+        a = copy.copy(args)
+        a.temperature = temp
+        a.ckpt, a.init_random = None, True
+        a.worker_heartbeat_s = 0.05
+        a.worker_heartbeat_timeout_s = 1.0
+        a.worker_respawn_backoff_s = 0.5
+        return a
+
+    def wait_ready(addr, token, timeout_s=180.0):
+        """Full authenticated hello round-trip: returns once the worker's
+        engine is built and answering (TCP workers bind before the jax
+        import, so connect alone proves nothing)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                s = dial(addr, timeout=10.0)
+                try:
+                    client_hello(s, token, peer=addr)
+                finally:
+                    s.close()
+                return
+            except (OSError, WireError) as e:
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"worker at {addr} never became ready: {e}"
+                    ) from e
+                time.sleep(0.2)
+
+    def start_fleet(temp, tmp):
+        """2*replicas authenticated TCP workers: replicas on victim host
+        h0, replicas spares on h1, each advertising its bound port into a
+        registration ledger the bench then sorts into pool files."""
+        token_path = os.path.join(tmp, "token")
+        with open(token_path, "w") as f:
+            f.write("bench-chaos-net-secret\n")
+        a = fleet_args(temp)
+        a.worker_auth_token_file = token_path
+        adv = os.path.join(tmp, "advertised")
+        open(adv, "w").close()
+        env = None
+        if (os.environ.get("JAX_PLATFORMS") or "").startswith("cpu"):
+            env = forced_host_device_env(serve.mesh_devices)
+        procs = []
+        n_workers = 2 * args.replicas
+        for i in range(n_workers):
+            host = "h0" if i < args.replicas else "h1"
+            argv = worker_argv(a, serve) + [
+                "--socket", "tcp://127.0.0.1:0",
+                "--host_id", host, "--advertise", adv,
+            ]
+            procs.append(subprocess.Popen(argv, env=env))
+        deadline = time.monotonic() + 180.0
+        while True:
+            try:
+                entries = read_worker_pool(adv)
+            except ValueError:
+                entries = []
+            if len(entries) == n_workers:
+                break
+            dead = [pr.pid for pr in procs if pr.poll() is not None]
+            if dead or time.monotonic() >= deadline:
+                for pr in procs:
+                    pr.kill()
+                raise RuntimeError(
+                    f"worker fleet failed to register: "
+                    f"{len(entries)}/{n_workers} advertised"
+                    + (f", pids {dead} exited" if dead else "")
+                )
+            time.sleep(0.2)
+        # Pool order decides initial adoption: victims (h0) first, so the
+        # chaos replay provably starts with every replica on the victim
+        # host. The advertise file's order is registration-racy — sort.
+        entries.sort(key=lambda e: (e["host_id"], e["addr"]))
+        token = load_auth_token(token_path)
+        for e in entries:
+            wait_ready(e["addr"], token)
+        direct = os.path.join(tmp, "pool_direct")
+        with open(direct, "w") as f:
+            for e in entries:
+                f.write(f"{e['host_id']} {e['addr']}\n")
+        proxies = [ChaosProxy(e["addr"]) for e in entries]
+        proxied = os.path.join(tmp, "pool_proxied")
+        with open(proxied, "w") as f:
+            for e, px in zip(entries, proxies):
+                f.write(f"{e['host_id']} {px.addr}\n")
+        victims = [px for e, px in zip(entries, proxies)
+                   if e["host_id"] == "h0"]
+        return procs, proxies, victims, token_path, direct, proxied
+
+    def injure(victims):
+        for px in victims:
+            if mode == "partition":
+                px.partition()
+            elif mode == "torn":
+                # 2 bytes into the next reply frame's 4-byte length
+                # prefix: a mid-header truncation the framing layer must
+                # turn into a loud WireError, never a desync.
+                px.tear(after_bytes=2)
+            elif mode == "slow":
+                px.set_latency(10.0)    # >> heartbeat timeout: slow = dead
+            else:                       # blackhole
+                px.blackhole("down")
+
+    def replay(temp, placement, pool=None, token_path=None, victims=None):
+        chaos = victims is not None
+        spawner = None
+        if placement == "remote":
+            a = fleet_args(temp)
+            a.worker_pool = pool
+            a.worker_auth_token_file = token_path
+            if chaos:
+                # Adoption probes through an injured link must fail fast,
+                # not burn the 120s default (every engine is already
+                # built, so a healthy hello is instant).
+                a.worker_connect_timeout_s = 3.0
+            spawner = remote_spawner_from_args(
+                a, serve, initial_replicas=args.replicas)
+            factory = spawner
+        else:
+            def factory():
+                return ServingEngine(params, config, serve,
+                                     temperature=temp, top_k=args.top_k)
+        router = ReplicaRouter(
+            factory, replicas=args.replicas,
+            # Chaos headroom: every victim-host replica keeps its FAILED
+            # index and needs a replacement slot on the survivor host.
+            max_replicas=args.replicas * (2 if chaos else 1),
+            policy=args.route,
+        )
+        if spawner is not None:
+            spawner.router = router
+        scaler = None
+        if chaos:
+            scaler = Autoscaler(router, min_replicas=args.replicas,
+                                max_replicas=args.replicas * 2)
+        driver = EngineDriver(
+            router, autoscaler=scaler,
+            autoscale_every=max(25, kill_step + 1),
+            request_timeout_s=args.request_timeout_s,
+            watchdog_timeout_s=args.watchdog_timeout_s,
+        )
+        bs = serve.block_size
+        cap = config.n_positions - 2
+        buckets = ({-(-max(len(pr) for pr in prompts) // bs)}
+                   if serve.prefill_chunk else
+                   {-(-len(pr) // bs) for pr in prompts})
+        for eng in router.engines:
+            for nb in sorted(buckets):
+                eng.submit([3 + nb] * min(nb * bs, cap), 2, rng=0)
+            eng.run_until_idle()
+            eng.clear_prefix_cache()
+            eng.stats = {k: type(v)() for k, v in eng.stats.items()}
+
+        tok_times: dict[int, list[float]] = {}
+
+        def on_token(req, _tok, _tt=tok_times):
+            _tt.setdefault(req.id, []).append(time.monotonic())
+
+        handles = []
+        placed: dict[int, int] = {}
+        t_fail = None
+        fired = False
+        nxt = 0
+        t0 = time.monotonic()
+        while nxt < n or driver.has_work():
+            now = time.monotonic() - t0
+            while nxt < n and arrivals[nxt] <= now:
+                h = driver.submit(prompts[nxt], int(news[nxt]),
+                                  rng=keys[nxt], on_token=on_token)
+                placed[h.id] = h.replica
+                handles.append(h)
+                nxt += 1
+            if driver.has_work():
+                if chaos and not fired and driver.steps >= kill_step:
+                    fired = True
+                    injure(victims)
+                    # Let the heartbeat window lapse so the NEXT health
+                    # sweep probes every worker and sees the whole host
+                    # fail at once — detection through the supervision
+                    # plane, as a real partition would be.
+                    time.sleep(0.3)
+                driver.step()
+                if t_fail is None and router.replica_failures:
+                    t_fail = time.monotonic()
+            elif nxt < n:
+                time.sleep(min(0.001, max(0.0, arrivals[nxt] - now)))
+        wall = time.monotonic() - t0
+
+        host_rejoined = None
+        if chaos:
+            # Heal the victim links and prove re-admission: the dial
+            # probe reaches h0 again and lifts the quarantine
+            # (host_joined). Non-partition injuries leave the listener
+            # up, so h0 may have rejoined mid-replay already.
+            for px in victims:
+                px.heal()
+            deadline = time.monotonic() + 15.0
+            while ("h0" in spawner.dead_hosts
+                   and time.monotonic() < deadline):
+                router.poll_hosts()
+                time.sleep(0.2)
+            host_rejoined = "h0" not in spawner.dead_hosts
+        driver.close()
+        assert all(h.done for h in handles)
+
+        migrated = [h for h in handles if h.replica != placed[h.id]]
+        recovery = None
+        if t_fail is not None and migrated:
+            resumed = [min((t for t in tok_times.get(h.id, [])
+                            if t > t_fail), default=None) for h in migrated]
+            if all(r is not None for r in resumed):
+                recovery = max(resumed) - t_fail
+        emitted = sum(len(h.generated) for h in handles)
+        rec = {
+            "wall_s": round(wall, 4),
+            "tok_s": round(emitted / wall, 1),
+            "completed": sum(h.finish_reason in ("eos", "length")
+                             for h in handles),
+            "replica_failures": router.replica_failures,
+            "migrated_streams": router.migrated,
+            "watchdog_trips": driver.watchdog_trips,
+            "timeouts": sum(h.finish_reason == "timeout" for h in handles),
+            "failed_streams": sum(h.finish_reason == "failed"
+                                  for h in handles),
+            "re_emitted_tokens": sum(
+                len(tok_times.get(h.id, [])) - len(h.generated)
+                for h in handles
+            ),
+            "recovery_s": (round(recovery, 4) if recovery is not None
+                           else None),
+        }
+        if spawner is not None:
+            rec["worker_restarts"] = spawner.respawns
+        if chaos:
+            rec["host_failures"] = router.host_failures
+            rec["hosts_active_after"] = spawner.hosts_active
+            rec["host_rejoined"] = host_rejoined
+        return rec, [list(h.generated) for h in handles]
+
+    out = {
+        "net": mode,
+        "trace": meta,
+        "replicas": args.replicas,
+        "policy": args.route,
+        "hosts": {"h0": args.replicas, "h1": args.replicas},
+        "fire_at_step": kill_step,
+        "serve": {"max_batch": serve.max_batch,
+                  "block_size": serve.block_size,
+                  "num_blocks": serve.num_blocks,
+                  "prefill_chunk": serve.prefill_chunk,
+                  "prefix_cache": serve.prefix_cache,
+                  "admission": serve.admission},
+        "worker": {"max_respawns": args.worker_max_respawns,
+                   "respawn_backoff_s": 0.5,
+                   "rpc_timeout_s": args.worker_rpc_timeout_s,
+                   "heartbeat_s": 0.05,
+                   "heartbeat_timeout_s": 1.0,
+                   "authenticated": True},
+    }
+    for label, temp in (("greedy", 0.0), ("sampled", 1.0)):
+        tmp = tempfile.mkdtemp(prefix="gpt2tpu-chaosnet-")
+        procs, proxies, victims, token_path, direct, proxied = (
+            start_fleet(temp, tmp))
+        try:
+            ref_rec, ref_streams = replay(temp, "inprocess")
+            net_rec, net_streams = replay(
+                temp, "remote", pool=direct, token_path=token_path)
+            chaos_rec, chaos_streams = replay(
+                temp, "remote", pool=proxied, token_path=token_path,
+                victims=victims)
+        finally:
+            for px in proxies:
+                px.close()
+            for pr in procs:
+                pr.terminate()
+            for pr in procs:
+                try:
+                    pr.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
+            shutil.rmtree(tmp, ignore_errors=True)
+        out[label] = {
+            "inprocess": ref_rec,
+            "remote": net_rec,
+            "remote_chaos": chaos_rec,
+            "streams_bit_identical": (net_streams == ref_streams
+                                      and chaos_streams == ref_streams),
+        }
+    g = out["greedy"]
+    out["rpc_overhead"] = {
+        "inprocess_tok_s": g["inprocess"]["tok_s"],
+        "remote_tok_s": g["remote"]["tok_s"],
+        # Per-token cost of the TCP hop vs the in-process fleet; §20
+        # compares this against chaos_proc's Unix-socket number to price
+        # TCP framing + loopback specifically.
+        "per_token_overhead_us": round(
+            (1.0 / g["remote"]["tok_s"]
+             - 1.0 / g["inprocess"]["tok_s"]) * 1e6, 1),
+    }
+    return out
+
+
 def main(argv=None) -> None:
     p = build_argparser()
     args = p.parse_args(argv)
@@ -1256,6 +1669,51 @@ def main(argv=None) -> None:
         return FaultInjector(fail_at=args.fail_spec,
                              hang_at=args.hang_spec,
                              exception_at=args.inject_step_exception)
+
+    if args.chaos and args.chaos_net is not None:
+        serve_new, _ = serve_pair(
+            args.num_blocks_shared or args.num_blocks
+            if args.traces != "original" else args.num_blocks
+        )
+        rec = run_chaos_net(args, params, config, serve_new, jax, np)
+        _XLA_CAPTURE.stop_if_active()
+        get_tracer().close()
+        if args.json:
+            out = {"bench": "serve",
+                   "device": jax.devices()[0].device_kind,
+                   "n_devices": jax.device_count(),
+                   "model": {"preset": args.model, **overrides}}
+            if os.path.exists(args.json):
+                with open(args.json) as f:
+                    out = json.load(f)
+            # Keyed by injury mode: one invocation per --chaos_net,
+            # records accumulate in the same file.
+            out.setdefault("chaos_net", {})[args.chaos_net] = rec
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps({"chaos_net": {args.chaos_net: rec}}))
+        for mode in ("greedy", "sampled"):
+            krec = rec[mode]["remote_chaos"]
+            if krec["host_failures"] == 0:
+                sys.exit(f"chaos_net[{mode}]: the {args.chaos_net} injury "
+                         "never took the host down — either the run "
+                         "finished before its trigger step or the failure "
+                         "was not contained as a host domain")
+            if not rec[mode]["streams_bit_identical"]:
+                sys.exit(f"chaos_net[{mode}]: token streams diverged from "
+                         "the in-process reference — the TCP boundary or "
+                         "the host-death migration broke bit-exactness")
+            if krec["re_emitted_tokens"] != 0:
+                sys.exit(f"chaos_net[{mode}]: "
+                         f"{krec['re_emitted_tokens']} token(s) were "
+                         "re-emitted across the host migration — the "
+                         "zero-re-emission contract is broken")
+            if krec["failed_streams"] != 0:
+                sys.exit(f"chaos_net[{mode}]: {krec['failed_streams']} "
+                         "stream(s) died with the host instead of "
+                         "migrating — containment is incomplete")
+        return
 
     if args.chaos and args.placement == "subprocess":
         serve_new, _ = serve_pair(

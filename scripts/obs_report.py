@@ -294,8 +294,12 @@ def worker_lifecycle(records: list[dict[str, Any]]) -> dict[str, Any] | None:
     ``worker_respawn`` (the backoff before a replacement spawn) and
     ``heartbeat_loss`` event, in time order. Spawns that replaced a dead
     worker carry ``respawn > 0``; a healthy fleet shows only the initial
-    spawns. None when the run never used subprocess placement."""
-    names = {"worker_spawn", "worker_respawn", "heartbeat_loss"}
+    spawns. Remote placement adds ``host_lost`` (a whole failure domain
+    contained as one batch) and ``host_joined`` (a quarantined host
+    dial-probed back into service). None when the run never used
+    subprocess or remote placement."""
+    names = {"worker_spawn", "worker_respawn", "heartbeat_loss",
+             "host_lost", "host_joined"}
     evs = sorted(
         (r for r in records
          if r.get("ph") == "event" and r.get("name") in names),
@@ -312,17 +316,30 @@ def worker_lifecycle(records: list[dict[str, Any]]) -> dict[str, Any] | None:
             row["pid"] = a.get("pid")
             row["spawn"] = a.get("spawn")
             row["respawn"] = a.get("respawn")
+            if a.get("host_id") is not None:
+                row["host_id"] = a.get("host_id")
         elif e["name"] == "worker_respawn":
             row["respawn"] = a.get("respawn")
             row["backoff_s"] = a.get("backoff_s")
+        elif e["name"] == "host_lost":
+            row["host_id"] = a.get("host_id")
+            row["replicas"] = a.get("replicas")
+            row["reason"] = a.get("reason")
+        elif e["name"] == "host_joined":
+            row["host_id"] = a.get("host_id")
         else:  # heartbeat_loss
             row["pid"] = a.get("pid")
+            if a.get("host_id") is not None:
+                row["host_id"] = a.get("host_id")
         rows.append(row)
     return {
         "n_spawns": sum(1 for r in rows if r["event"] == "worker_spawn"),
         "n_respawns": sum(1 for r in rows if r["event"] == "worker_respawn"),
         "n_heartbeat_losses": sum(
             1 for r in rows if r["event"] == "heartbeat_loss"),
+        "n_hosts_lost": sum(1 for r in rows if r["event"] == "host_lost"),
+        "n_hosts_joined": sum(
+            1 for r in rows if r["event"] == "host_joined"),
         "events": rows,
     }
 
@@ -407,22 +424,38 @@ def _print_frontend(report: dict[str, Any], limit: int) -> None:
               f"{fs['n_timed_out']} timed out, {fs['n_failed']} failed")
     workers = report.get("workers")
     if workers:
+        hosts = ""
+        if workers.get("n_hosts_lost") or workers.get("n_hosts_joined"):
+            hosts = (f", {workers['n_hosts_lost']} host(s) lost, "
+                     f"{workers['n_hosts_joined']} host(s) rejoined")
         print(f"  worker lifecycle: {workers['n_spawns']} spawn(s), "
               f"{workers['n_respawns']} respawn(s), "
-              f"{workers['n_heartbeat_losses']} heartbeat loss(es)")
+              f"{workers['n_heartbeat_losses']} heartbeat loss(es)"
+              f"{hosts}")
         for w in workers["events"]:
             if w["event"] == "worker_spawn":
                 tag = (f"respawn #{w['respawn']}" if w.get("respawn")
                        else f"initial spawn #{w.get('spawn')}")
+                if w.get("host_id"):
+                    tag += f", host {w['host_id']}"
                 print(f"    +{w['t_ms']:>9.1f} ms  worker_spawn    "
                       f"pid={w.get('pid')}  ({tag})")
             elif w["event"] == "worker_respawn":
                 print(f"    +{w['t_ms']:>9.1f} ms  worker_respawn  "
                       f"#{w.get('respawn')} after "
                       f"{w.get('backoff_s', 0):g}s backoff")
+            elif w["event"] == "host_lost":
+                print(f"    +{w['t_ms']:>9.1f} ms  host_lost       "
+                      f"{w.get('host_id')}  replicas={w.get('replicas')} "
+                      f"({w.get('reason')})")
+            elif w["event"] == "host_joined":
+                print(f"    +{w['t_ms']:>9.1f} ms  host_joined     "
+                      f"{w.get('host_id')}")
             else:
+                extra = (f"  host={w['host_id']}" if w.get("host_id")
+                         else "")
                 print(f"    +{w['t_ms']:>9.1f} ms  heartbeat_loss  "
-                      f"pid={w.get('pid')}")
+                      f"pid={w.get('pid')}{extra}")
     print(f"  {'rid':<8} {'replica':>7} {'policy':<12} {'aff_blk':>7} "
           f"{'queue_ms':>9} {'ttft_ms':>9} {'finish_ms':>10}")
     shown = 0

@@ -29,8 +29,10 @@ from gpt_2_distributed_tpu import checkpoint as ckpt
 from gpt_2_distributed_tpu import train as train_mod
 from gpt_2_distributed_tpu.data.dataloader import (
     TokenShardDataset,
+    cursor_plan_digest,
     get_shard_paths,
     plan_cursor_migration,
+    replay_cursor_history,
 )
 from gpt_2_distributed_tpu.models import gpt2
 from gpt_2_distributed_tpu.parallel.mesh import (
@@ -160,18 +162,21 @@ def _full_epoch_counter(shard_paths, seq_len, epoch) -> Counter:
 
 def _old_world_consumption(
     shard_paths, seq_len, epoch, process_count, num_workers, batch_size,
-    consumed_batches,
+    consumed_batches, consumed=None,
 ) -> Counter:
     """Ground truth, independent of plan_cursor_migration: replay the actual
     consumer — per process, worker streams drained batch-by-batch in
     round-robin order (the DataLoader's schedule) — and collect the windows
-    of the first ``consumed_batches`` batches."""
+    of the first ``consumed_batches`` batches. ``consumed`` replays a world
+    that was itself resumed onto a plan's complement (second-resize case)."""
     eaten: Counter = Counter()
     for p in range(process_count):
         ds = TokenShardDataset(
             shard_paths, seq_len=seq_len, process_index=p,
             process_count=process_count, num_workers=num_workers,
         )
+        if consumed:
+            ds.set_consumed(consumed, epoch)
         ds.set_epoch(epoch)
         streams = [ds.iter_worker(w) for w in range(num_workers)]
         remaining = ds.worker_batches(batch_size)
@@ -293,6 +298,116 @@ def test_set_consumed_shrinks_counts_and_clears_on_epoch_change(shard_dir):
     )
     with pytest.raises(ValueError, match="shard-stride"):
         eval_ds.set_consumed(plan, epoch=0)
+
+
+# --- second same-epoch resize: history fold + plan digest (PR 19) ------------
+
+
+def test_second_resize_history_fold_is_exact(shard_dir):
+    """Two resizes inside one epoch: world A consumes a prefix, world B
+    resumes on the complement and consumes more, world C resumes on the
+    fold of both. The three consumptions must tile the epoch EXACTLY (as
+    multisets of window bytes) — the case the old single-plan scheme
+    documented as 'approximate there'."""
+    shard_paths = get_shard_paths(shard_dir, "train")
+    seq_len, epoch, batch = 32, 0, 4
+    k1, k2 = 6, 5   # optimizer steps (grad_accum 1) at each handoff
+    resize_a = {"process_count": 2, "workers": 2, "local_batch": batch,
+                "grad_accum_steps": 1, "steps": k1}
+    resize_b = {"process_count": 1, "workers": 1, "local_batch": batch,
+                "grad_accum_steps": 1, "steps": k1 + k2}
+
+    eaten_a = _old_world_consumption(
+        shard_paths, seq_len, epoch, 2, 2, batch, k1,
+    )
+    plan_a = replay_cursor_history(
+        shard_paths, seq_len=seq_len, epoch=epoch, resizes=[resize_a],
+    )
+    # World B ran on plan_a's complement; its ground-truth consumption
+    # must replay on the same filtered streams.
+    eaten_b = _old_world_consumption(
+        shard_paths, seq_len, epoch, 1, 1, batch, k2, consumed=plan_a,
+    )
+    plan_ab = replay_cursor_history(
+        shard_paths, seq_len=seq_len, epoch=epoch,
+        resizes=[resize_a, resize_b],
+    )
+    # The fold covers exactly what both worlds ate: no window counted
+    # twice, none forgotten.
+    assert sum(len(v) for v in plan_ab.values()) == sum(
+        (eaten_a + eaten_b).values()
+    )
+
+    complement: Counter = Counter()
+    for p in range(2):
+        ds = TokenShardDataset(
+            shard_paths, seq_len=seq_len, process_index=p,
+            process_count=2, num_workers=1,
+        )
+        ds.set_consumed(plan_ab, epoch=epoch)
+        ds.set_epoch(epoch)
+        complement.update(_window_counter(ds.iter_worker(0)))
+    assert eaten_a + eaten_b + complement == _full_epoch_counter(
+        shard_paths, seq_len, epoch
+    )
+
+
+def test_cursor_plan_digest_stable_across_roots_and_detects_divergence(
+    shard_dir, tmp_path
+):
+    """The digest a checkpoint persists must reproduce from a recomputed
+    plan (including when the data root moved — shard identity is the
+    basename), and must CHANGE when the consumed windows change — that
+    inequality is what turns a second same-epoch resize over altered
+    shards into a loud refusal instead of a silent wrong stream."""
+    import shutil
+
+    shard_paths = get_shard_paths(shard_dir, "train")
+    kw = dict(seq_len=32, epoch=0, old_process_count=2, old_num_workers=2,
+              old_batch_size=4, consumed_batches=6)
+    plan = plan_cursor_migration(shard_paths, **kw)
+    assert cursor_plan_digest(plan) == cursor_plan_digest(
+        plan_cursor_migration(shard_paths, **kw)
+    )
+
+    # Same shards under a different root: same digest.
+    moved = tmp_path / "moved_root"
+    moved.mkdir()
+    for p in shard_paths:
+        shutil.copy(p, moved)
+    moved_paths = get_shard_paths(str(moved), "train")
+    assert [p for p in moved_paths] != shard_paths
+    assert cursor_plan_digest(
+        plan_cursor_migration(moved_paths, **kw)
+    ) == cursor_plan_digest(plan)
+
+    # Any change to the consumed set diverges.
+    tampered = {p: set(offs) for p, offs in plan.items()}
+    path0 = next(iter(tampered))
+    tampered[path0].pop()
+    assert cursor_plan_digest(tampered) != cursor_plan_digest(plan)
+    # More consumption diverges too (a different history, not a superset
+    # collision).
+    kw2 = dict(kw, consumed_batches=7)
+    assert cursor_plan_digest(
+        plan_cursor_migration(shard_paths, **kw2)
+    ) != cursor_plan_digest(plan)
+
+
+def test_meta_cursor_plan_roundtrip_and_legacy():
+    record = {
+        "epoch": 2, "digest": "ab" * 32, "windows": 48,
+        "resizes": [{"process_count": 2, "workers": 2, "local_batch": 4,
+                     "grad_accum_steps": 1, "steps": 6}],
+    }
+    meta = ckpt.CheckpointMeta(
+        step=9, epoch=2, batches_in_epoch=9, rng_seed=1,
+        cursor_plan=record,
+    )
+    assert ckpt.CheckpointMeta.from_json(meta.to_json()).cursor_plan == record
+    # meta.json files written before this field still load.
+    legacy = '{"step": 3, "epoch": 0, "batches_in_epoch": 3, "rng_seed": 1}'
+    assert ckpt.CheckpointMeta.from_json(legacy).cursor_plan is None
 
 
 # --- cross-world restore of shard_update moments -----------------------------
