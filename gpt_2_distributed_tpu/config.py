@@ -357,6 +357,21 @@ class ServeConfig:
       one-chunk-per-step behavior. Only meaningful with
       ``prefill_chunk > 0``; the row count is padded to ``prefill_batch``
       so the batched chunk program still compiles exactly once.
+
+    Speculative decoding knob:
+
+    * ``spec`` — speculative-decoding spec, ``"draft:<preset>,k:<K>"``
+      (``=`` also accepted as the separator; ``""`` = speculation off, the
+      default). ``draft`` names the smaller drafting model (a
+      :data:`MODEL_PRESETS` key — the engine may substitute an explicit
+      draft config, e.g. the shrunken CPU test config drafting for 124M);
+      ``k`` is the draft run length per verify pass. The draft model gets
+      its own KV block pool (same allocator machinery, independent block
+      size/count) and its KV is disposable: preemption and cross-engine
+      migration discard it and re-draft, so the request wire format is
+      unchanged. Greedy streams stay bit-equal to the non-speculative
+      engine for any k; sampled streams are target-distributed via the
+      standard acceptance/resample rule.
     """
 
     max_batch: int = 8
@@ -370,6 +385,7 @@ class ServeConfig:
     watermark_blocks: int = 1
     mesh: str = ""
     prefill_batch: int = 1
+    spec: str = ""
 
     def __post_init__(self) -> None:
         if self.max_batch < 1:
@@ -426,6 +442,7 @@ class ServeConfig:
                 f"prefill_batch={self.prefill_batch} must be in "
                 f"[1, max_batch={self.max_batch}]"
             )
+        self.spec_axes()  # raises on a malformed spec
 
     def mesh_axes(self) -> tuple[int, int]:
         """Parse ``mesh`` into ``(data, tp)`` degrees (``""`` -> (1, 1));
@@ -437,6 +454,16 @@ class ServeConfig:
         """Total devices the mesh spec asks for (1 = unsharded engine)."""
         data, tp = self.mesh_axes()
         return data * tp
+
+    def spec_axes(self) -> tuple[str | None, int]:
+        """Parse ``spec`` into ``(draft_preset, k)`` (``""`` -> (None, 0));
+        see :func:`parse_serve_spec`."""
+        return parse_serve_spec(self.spec)
+
+    @property
+    def spec_k(self) -> int:
+        """Draft run length per verify pass (0 = speculation off)."""
+        return self.spec_axes()[1]
 
     def max_blocks_per_seq(self, n_positions: int) -> int:
         """Static block-table width: enough blocks for a full-context
@@ -481,6 +508,66 @@ def parse_serve_mesh(mesh: str) -> tuple[int, int]:
             )
         degrees[name] = n
     return degrees["data"], degrees["tp"]
+
+
+def parse_serve_spec(spec: str) -> tuple[str | None, int]:
+    """Parse a speculative-decoding spec into ``(draft_preset, k)``
+    (``""`` -> (None, 0) — speculation off).
+
+    Accepts ``"draft:<preset>,k:<K>"`` (``=`` also accepted as the
+    separator, mirroring :func:`parse_serve_mesh`). Both keys are
+    required when the spec is non-empty: a draft model with no run
+    length (or vice versa) is a configuration bug, not a default.
+    Self-contained on purpose: config.py stays importable without jax,
+    so CLIs (``scripts/bench_serve.py``) can refuse a bad ``--spec_k``
+    or ``--draft_preset`` before any jax import.
+
+    The preset name is validated against :data:`MODEL_PRESETS` here; the
+    draft-smaller-than-target check needs the *target* config and lives
+    in :func:`validate_worker_flags` / the engine constructor.
+    """
+    if not spec:
+        return None, 0
+    draft: str | None = None
+    k: int | None = None
+    seen: set[str] = set()
+    for part in spec.split(","):
+        name, _, val = part.replace("=", ":").partition(":")
+        name = name.strip()
+        val = val.strip()
+        if name not in ("draft", "k"):
+            raise ValueError(
+                f"spec={spec!r}: unknown key {name!r} (speculation specs "
+                f"use 'draft' and 'k' only)"
+            )
+        if name in seen:
+            raise ValueError(f"spec={spec!r}: duplicate key {name!r}")
+        seen.add(name)
+        if name == "draft":
+            if val not in MODEL_PRESETS:
+                raise ValueError(
+                    f"spec={spec!r}: unknown draft preset {val!r} "
+                    f"(expected one of {', '.join(MODEL_PRESETS)})"
+                )
+            draft = val
+        else:
+            try:
+                k = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"spec={spec!r}: key 'k' needs an integer, got {val!r}"
+                ) from None
+            if k < 1:
+                raise ValueError(
+                    f"spec={spec!r}: k={k} must be >= 1 (use spec='' to "
+                    f"disable speculation)"
+                )
+    if draft is None or k is None:
+        raise ValueError(
+            f"spec={spec!r}: both 'draft' and 'k' are required "
+            f"(e.g. 'draft:124M,k:4')"
+        )
+    return draft, k
 
 
 # Replica placement modes for the serving frontend: `inprocess` builds
@@ -547,6 +634,57 @@ def validate_worker_flags(p, args) -> None:
             load_auth_token(args.worker_auth_token_file)
         except (OSError, ValueError) as e:
             p.error(f"--worker_auth_token_file: {e}")
+    # Speculative-decoding flags (getattr-guarded like the cross-host
+    # family: embedder namespaces may predate them). Everything here is
+    # computable jax-free — GPT2Config.num_params() is pure python — so a
+    # bad speculation flag is refused before the jax import, same as a bad
+    # mesh spec.
+    spec_k = getattr(args, "spec_k", None)
+    if spec_k is not None and spec_k < 1:
+        p.error(f"--spec_k must be >= 1, got {spec_k}")
+    draft = getattr(args, "draft_preset", None)
+    if draft is None:
+        # bench_serve's --spec A/B supplies its own self-sliced draft, so
+        # --spec_k is honorable there without a preset.
+        if spec_k is not None and not getattr(args, "spec", False):
+            p.error("--spec_k needs --draft_preset (speculation is opt-in "
+                    "via the draft model)")
+        if getattr(args, "draft_ckpt", None):
+            p.error("--draft_ckpt needs --draft_preset")
+    if draft is not None:
+        if draft not in MODEL_PRESETS:
+            p.error(
+                f"--draft_preset must be one of "
+                f"{'|'.join(MODEL_PRESETS)}, got {draft!r}"
+            )
+        target = MODEL_PRESETS.get(getattr(args, "model", None))
+        if target is not None:
+            overrides = {}
+            for flag, field in (
+                ("n_layer", "n_layer"),
+                ("n_embd", "n_embd"),
+                ("n_head", "n_head"),
+                ("vocab_size", "vocab_size"),
+                ("seq_len", "n_positions"),
+            ):
+                v = getattr(args, flag, None)
+                if v is not None:
+                    overrides[field] = v
+            try:
+                target = target.replace(**overrides)
+            except ValueError:
+                target = None  # malformed model flags fail elsewhere
+        if (
+            target is not None
+            and MODEL_PRESETS[draft].num_params() >= target.num_params()
+        ):
+            p.error(
+                f"--draft_preset {draft} "
+                f"({MODEL_PRESETS[draft].num_params():,} params) must be "
+                f"smaller than the target model "
+                f"({target.num_params():,} params): a draft at least as "
+                f"large as the target cannot speed up verification"
+            )
     pool = getattr(args, "worker_pool", None)
     if args.placement == "remote":
         if not pool:

@@ -262,6 +262,11 @@ for _name, _dist in (
     ("worker_restarts", "sum"),        # cumulative replacement worker respawns
     ("host_failures", "sum"),          # cumulative whole-host domains lost
     ("hosts_active", "max"),           # remote fleet hosts not quarantined
+    ("spec_draft_tokens", "sum"),      # cumulative draft-model proposals
+    ("spec_accepted_tokens", "sum"),   # cumulative proposals the target accepted
+    ("spec_rollbacks", "sum"),         # cumulative verify passes with a rejection
+    ("draft_ms", "sum"),               # cumulative draft-pass wall time
+    ("verify_ms", "sum"),              # cumulative target-verify wall time
 ):
     METRIC_REGISTRY.metric(
         _name, reduction=ReductionStrategy.CURRENT, tb_prefix="serve/",

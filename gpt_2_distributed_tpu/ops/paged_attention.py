@@ -148,6 +148,32 @@ def paged_prefill_attention(
     return o.transpose(0, 2, 1, 3)                   # [B, T, H, D]
 
 
+def spec_verify_attention(
+    q: jnp.ndarray,            # [B, T, H, D] verify-window queries
+    k_pool: jnp.ndarray,       # [N, H, bs, D]
+    v_pool: jnp.ndarray,       # [N, H, bs, D]
+    block_table: jnp.ndarray,  # [B, M] int32 pool indices
+    start: jnp.ndarray,        # [B] int32 absolute position of q[:, 0]
+) -> jnp.ndarray:
+    """Speculative-decoding verify pass: the target model re-scores a
+    draft run of T tokens (the committed decode input plus the drafted
+    continuation) in ONE call.
+
+    This is *exactly* a T-token chunked prefill over the request's
+    partially-built block table — query ``t`` sits at ``start[b] + t``,
+    attends causally over the table, and the caller has already
+    scattered the window's own K/V — so it delegates to
+    :func:`paged_prefill_attention` unchanged. The alias exists so the
+    verify pass has a named entry here (profiling, future Pallas
+    treatment) and so the bit-exactness argument is explicit: verify
+    shares every op with chunked prefill, which is already pinned
+    bit-identical to the dense path, so a greedy verify re-derives the
+    exact logits sequential decode would have produced at each drafted
+    position.
+    """
+    return paged_prefill_attention(q, k_pool, v_pool, block_table, start)
+
+
 def _paged_fwd_kernel(
     bt_ref,       # scalar prefetch: [B, M] int32 block table
     len_ref,      # scalar prefetch: [B] int32 lengths

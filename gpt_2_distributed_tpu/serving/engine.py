@@ -111,11 +111,13 @@ from gpt_2_distributed_tpu.ops.layers import layer_norm
 from gpt_2_distributed_tpu.ops.paged_attention import (
     paged_attention,
     paged_prefill_attention,
+    spec_verify_attention,
 )
 from gpt_2_distributed_tpu.serving.paged_cache import (
     BlockAllocator,
     PrefixCache,
     copy_block,
+    draft_serve_view,
     init_pools,
     make_pool_jits,
     pool_bytes,
@@ -472,6 +474,233 @@ def _decode_step_impl(
     return next_tokens.astype(jnp.int32), keys, kps, vps
 
 
+def _draft_step_impl(
+    params,
+    k_pool: jnp.ndarray,       # [L, N, H, bs, D] — DRAFT pool
+    v_pool: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, M] int32 — draft block table
+    tokens: jnp.ndarray,       # [B] int32 — token to process, at `pos`
+    pos: jnp.ndarray,          # [B] int32
+    active: jnp.ndarray,       # [B] bool
+    *,
+    config: GPT2Config,
+    attn_impl: str,
+):
+    """One draft-model decode step for speculative decoding: identical to
+    ``_decode_step_impl`` — same embedding gathers, same paged write, same
+    attention — but over the DRAFT pool/params, and returning the fp32
+    logits instead of sampling: the host owns draft-token selection
+    (argmax for greedy engines; inverse-CDF from the masked/tempered
+    draft distribution for sampled ones, whose probabilities the
+    acceptance rule needs anyway). No PRNG chain enters or leaves — draft
+    randomness comes from the per-round uniforms the engine derives from
+    each slot's chain head."""
+    bsz = tokens.shape[0]
+    dtype = k_pool.dtype
+    bs = k_pool.shape[3]
+    c = config.n_embd
+
+    tok = params["wte"].astype(dtype).at[tokens].get(mode="clip")
+    wpe = params["wpe"].astype(dtype).at[pos].get(mode="clip")   # [B, C]
+    x = (tok + wpe)[:, None]                                     # [B, 1, C]
+
+    lengths = jnp.where(active, pos + 1, 0).astype(jnp.int32)
+    blk = block_table[jnp.arange(bsz), jnp.minimum(pos // bs,
+                                                   block_table.shape[1] - 1)]
+    blk = jnp.where(active, blk, 0)   # idle rows scribble on the null block
+
+    off = pos % bs
+
+    def body(x, layer):
+        bp, kp, vp = layer            # kp/vp: [N, H, bs, D]
+        y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
+        q, k, v = gpt2.qkv_proj(config, y, bp)                   # [B, 1, H, D]
+        kp = kp.at[blk, :, off].set(k[:, 0])
+        vp = vp.at[blk, :, off].set(v[:, 0])
+        o = paged_attention(
+            q[:, 0], kp, vp, block_table, lengths, impl=attn_impl
+        )                                                        # [B, H, D]
+        o = gpt2.gather_attn_heads(o, data_rows=True)
+        o = o.reshape(bsz, 1, c)
+        o = o @ bp["attn_proj_w"].astype(x.dtype) + bp["attn_proj_b"].astype(x.dtype)
+        x = x + o
+        x = gpt2._mlp_sublayer(config, x, bp, None, True)
+        return x, (kp, vp)
+
+    x, (kps, vps) = jax.lax.scan(body, x, (params["block"], k_pool, v_pool))
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
+    logits = jnp.einsum(
+        "btc,vc->btv", x, params["wte"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )[:, 0]                                                      # [B, V] fp32
+    return logits, kps, vps
+
+
+def _spec_verify_impl(
+    params,
+    k_pool: jnp.ndarray,       # [L, N, H, bs, D] — donated
+    v_pool: jnp.ndarray,
+    bt: jnp.ndarray,           # [R, M] int32 block-table rows
+    chunk: jnp.ndarray,        # [R, T] int32 tokens, right-padded per row
+    start: jnp.ndarray,        # [R] int32 — absolute position of chunk[r, 0]
+    clen: jnp.ndarray,         # [R] int32 — real tokens per row (0 = pad row)
+    *,
+    config: GPT2Config,
+    return_logits: bool,
+):
+    """The speculative two-model engine's shared forward: a T-token window
+    through the model, K/V scattered into the pool at position
+    granularity, attention over the partially-built table via
+    ``spec_verify_attention``.
+
+    Two partials, two jobs:
+
+    * ``return_logits=True`` — the target VERIFY pass: chunk row r holds
+      ``[committed_token, d_1, .., d_K]`` (T = K+1) at positions
+      ``start_r ..``, and the fp32 logits at ALL T positions come back
+      (``"btc,vc->btv"`` instead of the last-position gather) — logits[i]
+      is the target distribution for position ``start_r + i + 1``, which
+      the host's acceptance rule scores the draft against. Every op
+      mirrors ``_chunk_prefill_impl`` (which is pinned bit-identical to
+      the dense path), so greedy argmaxes equal sequential decode's.
+    * ``return_logits=False`` — the DRAFT CATCH-UP pass: after admission,
+      preemption-resume or cross-engine adoption the draft pool holds
+      nothing (draft KV is disposable), so the engine re-drafts by
+      running the committed tokens through the draft model to rebuild
+      its KV; the logits (a ``[R, T, V]`` buffer at full window width)
+      are never formed.
+
+    Unlike ``_chunk_prefill_impl``, positions at or past
+    ``config.n_positions`` are masked out of the scatter: a verify
+    window straddling the context end must not wrap into (and corrupt)
+    the last real block's valid rows — dropped writes land nowhere, and
+    the host never emits past the context anyway."""
+    r, t = chunk.shape
+    n = k_pool.shape[1]
+    bs = k_pool.shape[3]
+    m = bt.shape[1]
+    dtype = k_pool.dtype
+    start = jnp.asarray(start, jnp.int32)
+    clen = jnp.asarray(clen, jnp.int32)
+
+    tok = params["wte"].astype(dtype).at[chunk].get(mode="clip")  # [R, T, E]
+    pos_ids = start[:, None] + jax.lax.iota(jnp.int32, t)[None]   # [R, T]
+    wpe = params["wpe"].astype(dtype).at[pos_ids].get(mode="clip")
+    x = tok + wpe
+
+    valid = jax.lax.iota(jnp.int32, t)[None] < clen[:, None]      # [R, T]
+    valid = valid & (pos_ids < config.n_positions)
+    blk = jnp.take_along_axis(bt, jnp.minimum(pos_ids // bs, m - 1), axis=1)
+    blk = jnp.where(valid, blk, n)   # out-of-range => scatter drops the row
+    off = pos_ids % bs
+
+    def body(x, layer):
+        bp, kp, vp = layer           # kp/vp: [N, H, bs, D]
+        y = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"], config.layer_norm_eps)
+        q, k, v = gpt2.qkv_proj(config, y, bp)                    # [R, T, H, D]
+        kp = kp.at[blk, :, off].set(k.astype(kp.dtype), mode="drop")
+        vp = vp.at[blk, :, off].set(v.astype(vp.dtype), mode="drop")
+        o = spec_verify_attention(q, kp, vp, bt, start)           # [R, T, H, D]
+        o = gpt2.gather_attn_heads(o)
+        o = o.reshape(r, t, config.n_embd)
+        o = o @ bp["attn_proj_w"].astype(x.dtype) + bp["attn_proj_b"].astype(x.dtype)
+        x = x + o
+        x = gpt2._mlp_sublayer(config, x, bp, None, True)
+        return x, (kp, vp)
+
+    x, (kps, vps) = jax.lax.scan(body, x, (params["block"], k_pool, v_pool))
+    if not return_logits:
+        return kps, vps
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], config.layer_norm_eps)
+    logits = jnp.einsum(
+        "btc,vc->btv", x, params["wte"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )                                                             # [R, T, V]
+    return logits, kps, vps
+
+
+def _spec_probs(logits, temperature: float, top_k: int | None) -> np.ndarray:
+    """fp64 next-token distribution(s) from fp32 logits, mirroring
+    ``sample_token``'s semantics exactly: kth-largest threshold with a
+    strict-less mask (``lax.top_k`` keeps ties at the threshold, so does
+    ``np.partition``), then temperature. Host-side because the
+    speculative acceptance rule (``_spec_round``) needs the draft and
+    target probabilities of specific tokens — fp64 so the accept/residual
+    arithmetic carries no meaningful rounding of its own, which is what
+    the target-distribution contract is tested against."""
+    l = np.asarray(logits, np.float64)
+    if top_k is not None:
+        kth = np.partition(l, -top_k, axis=-1)[..., -top_k][..., None]
+        l = np.where(l < kth, -np.inf, l)
+    l = l / temperature
+    l = l - l.max(axis=-1, keepdims=True)
+    e = np.exp(l)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _spec_cdf_sample(probs: np.ndarray, u: float) -> int:
+    """Inverse-CDF draw from one fp64 distribution with uniform ``u``.
+    ``u`` scales by the actual mass (fp64 sums are not exactly 1.0) and
+    the index clamps to the vocab — both guards are distribution-neutral."""
+    c = np.cumsum(probs)
+    return min(int(np.searchsorted(c, u * c[-1], side="right")), len(c) - 1)
+
+
+def _spec_accept(
+    vlogits: np.ndarray,            # [K+1, V] fp32 target verify logits
+    d_toks: np.ndarray,             # [K] int32 draft proposals
+    q_dists: list[np.ndarray] | None,  # K fp64 draft dists (None = greedy)
+    unis: np.ndarray | None,        # [3K+1] fp64 round uniforms (None = greedy)
+    temperature: float,
+    top_k: int | None,
+) -> tuple[list[int], int]:
+    """One slot's acceptance/resample rule -> (emitted tokens, accepted).
+
+    Greedy: accept while the draft token equals the verify argmax; the
+    first mismatch emits the argmax itself (the correction), a clean
+    sweep emits the bonus argmax — every emitted token is a target
+    argmax, which is the bit-equality argument in one line.
+
+    Sampled (the Leviathan/Chen rule): accept draft token ``d`` with
+    probability ``min(1, p(d)/q(d))``; on rejection resample from the
+    residual ``max(p - q, 0)`` renormalized; after a clean sweep the
+    bonus token comes straight from the last target distribution. Each
+    decision consumes the round uniform reserved for it (accept coins at
+    ``[K, 2K)``, residual draws at ``[2K, 3K)``, the bonus at ``3K``), so
+    the emitted prefix is provably distributed as sequential target
+    sampling — the property the fp64 Monte-Carlo test pins."""
+    k = len(d_toks)
+    emit: list[int] = []
+    accepted = 0
+    if q_dists is None:
+        for i in range(k):
+            g = int(vlogits[i].argmax())
+            emit.append(g)
+            if g != int(d_toks[i]):
+                return emit, accepted
+            accepted += 1
+        emit.append(int(vlogits[k].argmax()))
+        return emit, accepted
+    for i in range(k):
+        p = _spec_probs(vlogits[i], temperature, top_k)
+        d = int(d_toks[i])
+        if unis[k + i] * q_dists[i][d] < p[d]:
+            emit.append(d)
+            accepted += 1
+            continue
+        r = np.maximum(p - q_dists[i], 0.0)
+        z = float(r.sum())
+        # z == 0 only when q dominates p everywhere it lost — an
+        # fp64-measure-zero corner; falling back to p keeps the draw
+        # inside the target support.
+        r = r / z if z > 0.0 else p
+        emit.append(_spec_cdf_sample(r, unis[2 * k + i]))
+        return emit, accepted
+    p = _spec_probs(vlogits[k], temperature, top_k)
+    emit.append(_spec_cdf_sample(p, unis[3 * k]))
+    return emit, accepted
+
+
 class ServingEngine:
     """Continuous-batching serving engine. See the module docstring.
 
@@ -493,12 +722,53 @@ class ServingEngine:
         temperature: float = 0.0,
         top_k: int | None = None,
         compute_dtype=jnp.bfloat16,
+        draft_params=None,
+        draft_config: GPT2Config | None = None,
     ):
         serve = serve if serve is not None else ServeConfig()
         # Sampling params are engine-level (static in the compiled step);
         # validate top_k once here with the shared check so a bad engine
         # config fails like a bad request would.
         check_generation_args(config, 1, 1, top_k, batch=serve.max_batch)
+        # Speculative decoding (ServeConfig.spec) — default off, opt-in per
+        # engine. The draft model arrives as explicit params/config (the
+        # CLIs map --draft_preset to MODEL_PRESETS; tests pass a shrunken
+        # config directly), validated here with the same rules the jax-free
+        # flag check enforces at parse time.
+        self._draft_preset, self._spec_k = serve.spec_axes()
+        if self._spec_k:
+            if draft_params is None or draft_config is None:
+                raise ValueError(
+                    f"spec={serve.spec!r} enables speculative decoding but "
+                    f"no draft model was provided "
+                    f"(draft_params= / draft_config=)"
+                )
+            if draft_config.num_params() >= config.num_params():
+                raise ValueError(
+                    f"draft model ({draft_config.num_params():,} params) "
+                    f"must be smaller than the target "
+                    f"({config.num_params():,} params)"
+                )
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size={draft_config.vocab_size} must match "
+                    f"the target's {config.vocab_size}: acceptance compares "
+                    f"distributions over one token space"
+                )
+            if draft_config.n_positions < config.n_positions:
+                raise ValueError(
+                    f"draft n_positions={draft_config.n_positions} must "
+                    f"cover the target's {config.n_positions}: the draft "
+                    f"re-encodes the full committed prefix"
+                )
+        elif draft_params is not None or draft_config is not None:
+            raise ValueError(
+                "draft model provided but serve.spec is empty — "
+                "speculation is opt-in via ServeConfig.spec "
+                "('draft:<preset>,k:<K>')"
+            )
+        self.draft_params = draft_params
+        self.draft_config = draft_config
         self.params = params
         self.config = config
         self.serve = serve
@@ -516,6 +786,9 @@ class ServingEngine:
         decode_kw: dict = {}
         chunk_kw: dict = {}
         prefill_kw: dict = {}
+        spec_draft_kw: dict = {}
+        spec_catchup_kw: dict = {}
+        spec_verify_kw: dict = {}
         if self._dp * self._tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -577,6 +850,41 @@ class ServingEngine:
                 in_shardings=(param_sh, rep_sh, rep_sh, rep_sh),
                 out_shardings=(rep_sh, rep_sh, kv_sh, kv_sh),
             )
+            if self._spec_k:
+                if draft_config.n_head % self._tp != 0:
+                    raise ValueError(
+                        f"draft n_head={draft_config.n_head} must be "
+                        f"divisible by the tp degree {self._tp} (the draft "
+                        f"pool head-shards like the target pool)"
+                    )
+                draft_param_sh = jax.tree_util.tree_map(
+                    lambda spec: NamedSharding(self.mesh, spec),
+                    serve_param_pspecs(self.draft_params, self.mesh),
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+                self.draft_params = jax.device_put(
+                    self.draft_params, draft_param_sh
+                )
+                # Draft decode rows shard like target decode rows; the
+                # verify window and draft catch-up rows replicate like
+                # chunked prefill (same [R, T] row shapes, same scatter).
+                spec_draft_kw = dict(
+                    in_shardings=(draft_param_sh, pool_sharding,
+                                  pool_sharding, vec_sh, row_sh, row_sh,
+                                  row_sh),
+                    out_shardings=(vec_sh, pool_sharding, pool_sharding),
+                )
+                spec_catchup_kw = dict(
+                    in_shardings=(draft_param_sh, pool_sharding,
+                                  pool_sharding, rep_sh, rep_sh, rep_sh,
+                                  rep_sh),
+                    out_shardings=(pool_sharding, pool_sharding),
+                )
+                spec_verify_kw = dict(
+                    in_shardings=(param_sh, pool_sharding, pool_sharding,
+                                  rep_sh, rep_sh, rep_sh, rep_sh),
+                    out_shardings=(rep_sh, pool_sharding, pool_sharding),
+                )
             self._scatter_fn, self._copy_fn = make_pool_jits(pool_sharding)
         self.k_pool, self.v_pool = init_pools(
             config, serve, compute_dtype, sharding=pool_sharding
@@ -596,6 +904,37 @@ class ServingEngine:
         self.active = np.zeros((serve.max_batch,), bool)
         self.keys = np.zeros((serve.max_batch, 2), np.uint32)
 
+        # --- draft-model state (speculative decoding) ---------------------
+        # The draft pool pairs slot-for-slot with the target pool but is
+        # sized for full per-slot capacity (draft_serve_view), so its
+        # allocator can never fail mid-round. Draft KV is DISPOSABLE: it
+        # is rebuilt from the committed tokens (catch-up pass) after
+        # admission, preemption-resume and cross-engine adoption, and
+        # never serialized — migration wire format is unchanged.
+        if self._spec_k:
+            self._draft_serve = draft_serve_view(serve, config.n_positions)
+            self._draft_m = self._draft_serve.max_blocks_per_seq(
+                config.n_positions
+            )
+            self.dk_pool, self.dv_pool = init_pools(
+                draft_config, self._draft_serve, compute_dtype,
+                sharding=pool_sharding,
+            )
+            self._draft_alloc = BlockAllocator(
+                self._draft_serve.num_blocks, num_shards=self._dp
+            )
+            self.draft_table = np.zeros(
+                (serve.max_batch, self._draft_m), np.int32
+            )
+            self._draft_blocks: list[list[int] | None] = (
+                [None] * serve.max_batch
+            )
+            # Valid draft-KV frontier per slot: positions [0, _draft_pos)
+            # hold K/V consistent with the committed token stream. The
+            # round invariant (_spec_round) keeps it equal to `pos` after
+            # every spec round; 0 = no draft KV (catch-up required).
+            self._draft_pos = np.zeros((serve.max_batch,), np.int32)
+
         self._slots: list[RequestHandle | None] = [None] * serve.max_batch
         self._queue: collections.deque[RequestHandle] = collections.deque()
         self._next_id = 0
@@ -608,6 +947,8 @@ class ServingEngine:
             "preemptions": 0, "resumes": 0, "timeouts": 0,
             "prefix_hit_tokens": 0, "cow_copies": 0,
             "prefill_ms": 0.0, "decode_ms": 0.0, "queue_wait_ms": 0.0,
+            "spec_draft_tokens": 0, "spec_accepted_tokens": 0,
+            "spec_rollbacks": 0, "draft_ms": 0.0, "verify_ms": 0.0,
         }
 
         # Per-engine jits so tests can count THIS engine's compilations:
@@ -640,6 +981,50 @@ class ServingEngine:
             donate_argnames=("k_pool", "v_pool"),
             **chunk_kw,
         )
+        if self._spec_k:
+            # All three spec programs are shape-stable: the draft step at
+            # [max_batch] rows, the catch-up at the full draft window, the
+            # verify at T = spec_k + 1 — one compile each, preserving the
+            # engine's compile-once discipline.
+            self._draft_fn = jax.jit(
+                functools.partial(
+                    _draft_step_impl, config=draft_config,
+                    attn_impl=serve.attn_impl,
+                ),
+                donate_argnames=("k_pool", "v_pool"),
+                **spec_draft_kw,
+            )
+            self._draft_prefill_fn = jax.jit(
+                functools.partial(
+                    _spec_verify_impl, config=draft_config,
+                    return_logits=False,
+                ),
+                donate_argnames=("k_pool", "v_pool"),
+                **spec_catchup_kw,
+            )
+            self._verify_fn = jax.jit(
+                functools.partial(
+                    _spec_verify_impl, config=config, return_logits=True,
+                ),
+                donate_argnames=("k_pool", "v_pool"),
+                **spec_verify_kw,
+            )
+            if self.temperature > 0:
+                # One chain split per spec ROUND, and every uniform the
+                # round can consume (K draft samples, K acceptance coins,
+                # K residual samples, 1 bonus) derived from the sub in one
+                # dispatch. Sampled speculation relaxes bit-equality to
+                # distribution-equality, so the per-emitted-token split
+                # cadence of the sequential path is not replicated here.
+                spec_k = self._spec_k
+
+                def _round_entropy(keys):
+                    def one(key):
+                        key, sub = jax.random.split(key)
+                        return key, jax.random.uniform(sub, (3 * spec_k + 1,))
+                    return jax.vmap(one)(keys)
+
+                self._spec_keys_fn = jax.jit(_round_entropy)
         get_tracer().event(
             "engine_mesh", mesh=serve.mesh or "single",
             devices=self._dp * self._tp, data=self._dp, tp=self._tp,
@@ -1098,6 +1483,14 @@ class ServingEngine:
         self.block_table[slot, :] = 0
         self.pos[slot] = 0
         self.active[slot] = False
+        if self._spec_k and self._draft_blocks[slot] is not None:
+            # Draft KV dies with the slot — it is disposable state, never
+            # carried through preemption or migration (the next occupant
+            # re-drafts via the catch-up pass).
+            self._draft_alloc.release(self._draft_blocks[slot])
+            self._draft_blocks[slot] = None
+            self.draft_table[slot, :] = 0
+            self._draft_pos[slot] = 0
 
     def _evict(self, slot: int, reason: str) -> None:
         req = self._slots[slot]
@@ -1235,7 +1628,14 @@ class ServingEngine:
             if req is None or not self.active[slot]:
                 continue    # preempted by an older row's growth below
             shard = self._slot_shard(slot)
-            while int(self.pos[slot]) // bs >= len(req._blocks):
+            # A speculative round writes up to ``spec_k`` positions past
+            # ``pos`` (the verify window) before the next grow pass runs, so
+            # pre-grow to cover the whole window — clamped to the last
+            # position the request can ever legally write (``hard``), which
+            # keeps the final block count identical to the non-spec engine.
+            hard = len(req.prompt) + req.max_new_tokens - 2
+            last = min(int(self.pos[slot]) + (self._spec_k or 0), hard)
+            while last // bs >= len(req._blocks):
                 ids = self._alloc_blocks(1, 0, shard)
                 if ids is not None:
                     req._blocks.append(ids[0])
@@ -1311,6 +1711,12 @@ class ServingEngine:
             if not bool(self.active.any()):
                 return emitted
 
+        if self._spec_k:
+            # Two-model step: draft k tokens, verify them in one target
+            # pass, emit the accepted prefix (plus a bonus token when the
+            # whole draft survives). Replaces the single decode dispatch.
+            return emitted + self._spec_round(tracer)
+
         was_active = self.active.copy()
         decode_span = tracer.span(
             "decode", rows=int(was_active.sum())
@@ -1356,6 +1762,174 @@ class ServingEngine:
         self.stats["tokens_out"] += decoded  # prefill firsts counted at emit
         return emitted + decoded
 
+    def _spec_round(self, tracer) -> int:
+        """One speculative two-model step for every active row.
+
+        Shape of a round (K = ``spec_k``):
+
+        1. Draft catch-up (only when some row's draft-KV frontier trails
+           ``pos``: fresh admissions, preemption resumes, adoptions) —
+           one chunked pass of the committed tokens through the draft
+           model rebuilds its disposable KV.
+        2. K+1 draft decode steps: step i processes the token at position
+           ``pos + i`` (step 0 the committed pending token, then each
+           proposal) and proposes ``d_{i+1}``. The (K+1)-th step is
+           KV-only — it writes ``d_K``'s draft KV so the frontier lands
+           exactly on the new ``pos`` whatever the acceptance outcome,
+           and the steady state never needs catch-up.
+        3. ONE target verify pass: a (K+1)-token window
+           ``[pending, d_1 .. d_K]`` at positions ``pos ..`` through
+           ``spec_verify_attention`` — logits[i] is the target
+           distribution for position ``pos + i + 1``.
+        4. Host acceptance. Greedy: accept while the draft token equals
+           the verify argmax; every emitted token IS a verify argmax, so
+           streams are bit-equal to sequential decode for any K. Sampled
+           (Leviathan/Chen): accept ``d`` with prob ``min(1, p(d)/q(d))``,
+           resample rejections from ``max(p-q, 0)`` normalized, bonus
+           token from ``p_K`` after a clean sweep — emitted tokens are
+           exactly target-distributed. All uniforms for the round come
+           from ONE split of each slot's threefry chain head
+           (``_spec_keys_fn``).
+
+        Rolled-back target KV (positions past the accepted prefix) stays
+        in the pool as garbage that the per-sequence length masks already
+        make invisible — the same invariance the non-spec engine relies
+        on for preemption."""
+        K = self._spec_k
+        B = self.serve.max_batch
+        was_active = self.active.copy()
+        rows = int(was_active.sum())
+
+        # Lazy draft-block grant: full per-slot capacity (draft_serve_view)
+        # means this can never fail, so there is no draft preemption path.
+        for slot in range(B):
+            if was_active[slot] and self._draft_blocks[slot] is None:
+                ids = self._draft_alloc.alloc(
+                    self._draft_m, self._slot_shard(slot)
+                )
+                self._draft_blocks[slot] = ids
+                self.draft_table[slot, :len(ids)] = ids
+
+        sampled = self.temperature > 0
+        if sampled:
+            new_keys, unis = self._spec_keys_fn(self.keys)
+            unis = np.asarray(unis, np.float64)    # [B, 3K+1]
+            self.keys = np.where(
+                was_active[:, None], np.array(new_keys), self.keys
+            )
+
+        t0 = time.monotonic()
+        draft_span = tracer.span("draft", rows=rows, k=K).__enter__()
+        with self._mesh_scope():
+            clen_cu = np.where(
+                was_active, self.pos - self._draft_pos, 0
+            ).astype(np.int32)
+            if clen_cu.any():
+                width = self._draft_m * self._draft_serve.block_size
+                chunk = np.zeros((B, width), np.int32)
+                for slot in range(B):
+                    n = int(clen_cu[slot])
+                    if not n:
+                        continue
+                    req = self._slots[slot]
+                    seq = req.prompt + req.generated
+                    d0 = int(self._draft_pos[slot])
+                    chunk[slot, :n] = seq[d0:d0 + n]
+                self.dk_pool, self.dv_pool = self._draft_prefill_fn(
+                    self.draft_params, self.dk_pool, self.dv_pool,
+                    self.draft_table, chunk,
+                    self._draft_pos.astype(np.int32), clen_cu,
+                )
+            cur_tok = self.tokens.astype(np.int32)
+            cur_pos = self.pos.astype(np.int32)
+            d_toks = np.zeros((B, K), np.int32)
+            q_list: list[np.ndarray] = []
+            for i in range(K + 1):
+                logits, self.dk_pool, self.dv_pool = self._draft_fn(
+                    self.draft_params, self.dk_pool, self.dv_pool,
+                    self.draft_table, cur_tok, cur_pos, was_active,
+                )
+                if i == K:
+                    break    # KV-only step: its proposal is never used
+                dl = np.asarray(logits)                    # [B, V] fp32
+                if sampled:
+                    q = _spec_probs(dl, self.temperature, self.top_k)
+                    q_list.append(q)
+                    d = np.array(
+                        [_spec_cdf_sample(q[s], unis[s, i]) for s in range(B)],
+                        np.int32,
+                    )
+                else:
+                    d = dl.argmax(axis=-1).astype(np.int32)
+                d_toks[:, i] = d
+                cur_tok = d
+                cur_pos = cur_pos + 1
+        draft_span.__exit__(None, None, None)
+        t1 = time.monotonic()
+        self.stats["draft_ms"] += (t1 - t0) * 1e3
+        self.stats["spec_draft_tokens"] += K * rows
+
+        verify_span = tracer.span("verify", rows=rows, k=K).__enter__()
+        vtoks = np.zeros((B, K + 1), np.int32)
+        vtoks[:, 0] = self.tokens
+        vtoks[:, 1:] = d_toks
+        vclen = np.where(was_active, K + 1, 0).astype(np.int32)
+        with self._mesh_scope():
+            vlogits, self.k_pool, self.v_pool = self._verify_fn(
+                self.params, self.k_pool, self.v_pool, self.block_table,
+                vtoks, self.pos.astype(np.int32), vclen,
+            )
+        vlogits = np.asarray(vlogits)    # [B, K+1, V] — the device sync
+        verify_span.__exit__(None, None, None)
+        t2 = time.monotonic()
+        self.stats["verify_ms"] += (t2 - t1) * 1e3
+        self.stats["decode_ms"] += (t2 - t0) * 1e3
+        self.stats["decode_steps"] += 1
+
+        decoded = 0
+        now = time.monotonic()
+        for slot in range(B):
+            req = self._slots[slot]
+            if req is None or not was_active[slot]:
+                continue
+            emit, accepted = _spec_accept(
+                vlogits[slot], d_toks[slot],
+                [q[slot] for q in q_list] if sampled else None,
+                unis[slot] if sampled else None,
+                self.temperature, self.top_k,
+            )
+            self.stats["spec_accepted_tokens"] += accepted
+            if accepted < K:
+                self.stats["spec_rollbacks"] += 1
+            tracer.event(
+                "spec_accept", ts=now, rid=req.id,
+                drafted=K, accepted=accepted,
+            )
+            done = None
+            n_emitted = 0
+            for t in emit:
+                req.generated.append(t)
+                decoded += 1
+                n_emitted += 1
+                req._emit(t)
+                if self.serve.eos_id is not None and t == self.serve.eos_id:
+                    done = "eos"     # later emissions are dropped whole —
+                    break            # sequential decode never produces them
+                if len(req.generated) >= req.max_new_tokens:
+                    done = "length"
+                    break
+            if done is not None:
+                self._evict(slot, done)
+                continue
+            self.pos[slot] += n_emitted
+            self.tokens[slot] = emit[n_emitted - 1]
+            # Round invariant: the K+1 draft steps covered positions
+            # pos .. pos+K with tokens matching every committed prefix
+            # outcome, so the draft frontier lands exactly on the new pos.
+            self._draft_pos[slot] = self.pos[slot]
+        self.stats["tokens_out"] += decoded
+        return decoded
+
     def run_until_idle(self, max_steps: int | None = None) -> int:
         """Drive ``step`` until the queue and every slot drain. Returns
         total tokens emitted. ``submit``'s block-need check guarantees the
@@ -1392,6 +1966,13 @@ class ServingEngine:
             "serve_mesh_devices": float(self._dp * self._tp),
             "kv_pool_bytes_per_device": float(self.kv_pool_bytes_per_device),
             "prefill_batched": float(self.stats["prefill_batched"]),
+            "spec_draft_tokens": float(self.stats["spec_draft_tokens"]),
+            "spec_accepted_tokens": float(
+                self.stats["spec_accepted_tokens"]
+            ),
+            "spec_rollbacks": float(self.stats["spec_rollbacks"]),
+            "draft_ms": float(self.stats["draft_ms"]),
+            "verify_ms": float(self.stats["verify_ms"]),
         }
 
     def clear_prefix_cache(self) -> None:

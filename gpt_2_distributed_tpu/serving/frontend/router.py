@@ -494,6 +494,23 @@ class ReplicaRouter:
             "prefill_batched": float(
                 sum(e.stats["prefill_batched"] for e in self.engines)
             ),
+            # Speculative decoding (ServeConfig.spec): all-zero unless some
+            # replica runs a draft model.
+            "spec_draft_tokens": float(
+                sum(e.stats["spec_draft_tokens"] for e in self.engines)
+            ),
+            "spec_accepted_tokens": float(
+                sum(e.stats["spec_accepted_tokens"] for e in self.engines)
+            ),
+            "spec_rollbacks": float(
+                sum(e.stats["spec_rollbacks"] for e in self.engines)
+            ),
+            "draft_ms": float(
+                sum(e.stats["draft_ms"] for e in self.engines)
+            ),
+            "verify_ms": float(
+                sum(e.stats["verify_ms"] for e in self.engines)
+            ),
             # Subprocess placement: replacement workers spawned after a
             # failure (the spawner counts them); always 0 in-process.
             "worker_restarts": float(

@@ -642,11 +642,16 @@ def main(argv: list[str] | None = None) -> None:
         )
     else:
         from gpt_2_distributed_tpu.serving import ServingEngine
+        from gpt_2_distributed_tpu.serving.serve import load_draft_model
+
+        draft_config, draft_params = load_draft_model(args, config)
 
         def make_engine():
             return ServingEngine(params, config, serve,
                                  temperature=args.temperature,
-                                 top_k=args.top_k)
+                                 top_k=args.top_k,
+                                 draft_params=draft_params,
+                                 draft_config=draft_config)
     try:
         router = ReplicaRouter(
             make_engine,

@@ -607,6 +607,13 @@ def worker_argv(args: argparse.Namespace, serve: ServeConfig) -> list[str]:
         argv += ["--eos", str(serve.eos_id)]
     if serve.prefix_cache:
         argv += ["--prefix_cache"]
+    draft_preset, spec_k = serve.spec_axes()
+    if draft_preset is not None:
+        # Speculation shape from the RESOLVED config like the rest of the
+        # engine geometry; only the draft checkpoint path is a raw flag.
+        argv += ["--draft_preset", draft_preset, "--spec_k", str(spec_k)]
+        if getattr(args, "draft_ckpt", None):
+            argv += ["--draft_ckpt", args.draft_ckpt]
     if getattr(args, "top_k", None) is not None:
         argv += ["--top_k", str(args.top_k)]
     if getattr(args, "trace_dir", None):
@@ -1099,6 +1106,7 @@ def main(argv: list[str] | None = None) -> None:
     from gpt_2_distributed_tpu.serving import ServingEngine
     from gpt_2_distributed_tpu.serving.serve import (
         build_serve_config,
+        load_draft_model,
         load_model,
     )
 
@@ -1107,8 +1115,11 @@ def main(argv: list[str] | None = None) -> None:
                           max_file_bytes=args.trace_max_file_bytes)
     config, params = load_model(args)
     serve = build_serve_config(args, config)
+    draft_config, draft_params = load_draft_model(args, config)
     engine = ServingEngine(params, config, serve,
-                           temperature=args.temperature, top_k=args.top_k)
+                           temperature=args.temperature, top_k=args.top_k,
+                           draft_params=draft_params,
+                           draft_config=draft_config)
     print(f"[worker pid={os.getpid()}] engine ready on {bound} "
           f"(mesh={serve.mesh or 'single'}, devices={serve.mesh_devices})",
           file=sys.stderr)

@@ -20,6 +20,7 @@ alloc/release and loud failure on double-free/foreign ids. All policy
 from __future__ import annotations
 
 import collections
+import dataclasses
 import functools
 from typing import Iterable
 
@@ -248,6 +249,37 @@ def init_pools(
         )
         return zeros(), zeros()
     return jnp.zeros(shape, compute_dtype), jnp.zeros(shape, compute_dtype)
+
+
+def draft_serve_view(
+    serve: ServeConfig,
+    n_positions: int,
+    block_size: int | None = None,
+) -> ServeConfig:
+    """ServeConfig describing the draft model's KV block pool.
+
+    Same slot geometry as the target (the draft's slot tables are paired
+    1:1 with the target's), same mesh, but an independent block size and
+    a block count sized so every slot can hold a full-context draft
+    sequence: ``data * (slots_per_shard * max_blocks_per_seq + 1)``
+    blocks — the ``+1`` per shard covers the reserved null block on
+    shard 0 and keeps the shards uniform. Draft KV is disposable
+    (discarded on preemption/migration and re-drafted), so full
+    per-slot capacity — rather than the target pool's oversubscribed
+    paging — buys the engine a draft allocator that can never fail
+    mid-round. ``spec`` is cleared: the draft never speculates.
+    """
+    bs = serve.block_size if block_size is None else block_size
+    data, _ = serve.mesh_axes()
+    m = -(-n_positions // bs)
+    slots_per_shard = serve.max_batch // data
+    return dataclasses.replace(
+        serve,
+        spec="",
+        block_size=bs,
+        num_blocks=data * (slots_per_shard * m + 1),
+        prefix_cache=False,
+    )
 
 
 def pool_bytes(config: GPT2Config, serve: ServeConfig, itemsize: int = 2) -> int:

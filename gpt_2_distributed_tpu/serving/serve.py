@@ -103,6 +103,18 @@ def add_engine_flags(p: argparse.ArgumentParser) -> None:
                    "lazy growth with preemption under pool pressure")
     p.add_argument("--watermark_blocks", type=int, default=1,
                    help="free-block floor for --admission watermark")
+    p.add_argument("--draft_preset", default=None,
+                   help="speculative decoding: draft-model preset (must be "
+                        "smaller than --model); greedy streams stay "
+                        "bit-identical, sampled streams stay "
+                        "target-distributed")
+    p.add_argument("--spec_k", type=int, default=None,
+                   help="draft tokens per verify pass (default 4; needs "
+                        "--draft_preset)")
+    p.add_argument("--draft_ckpt", default=None,
+                   help="draft-model checkpoint dir; seeded init when "
+                        "omitted (a random draft is correct, just "
+                        "rarely accepted)")
 
 
 def add_obs_flags(p: argparse.ArgumentParser) -> None:
@@ -311,6 +323,50 @@ def load_model(args: argparse.Namespace):
     return config, params
 
 
+def load_draft_model(args: argparse.Namespace, config):
+    """(draft_config, draft_params) from ``--draft_preset``, or
+    ``(None, None)`` when speculation is off. The draft inherits the
+    target's vocab and context window (acceptance compares distributions
+    over one token space; the draft re-encodes the full committed
+    prefix), keeping the preset's depth/width. Weights come from
+    ``--draft_ckpt`` when given, seeded init otherwise — a random draft
+    is still *correct* (verification guarantees the output distribution),
+    it just accepts little. Call after the jax platform is pinned."""
+    draft = getattr(args, "draft_preset", None)
+    if draft is None:
+        return None, None
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS
+    from gpt_2_distributed_tpu.models import gpt2
+
+    draft_config = MODEL_PRESETS[draft].replace(
+        vocab_size=config.vocab_size, n_positions=config.n_positions
+    )
+    ckpt = getattr(args, "draft_ckpt", None)
+    if ckpt:
+        import jax
+
+        from gpt_2_distributed_tpu.checkpoint import (
+            latest_checkpoint,
+            restore_params,
+        )
+
+        path = os.path.abspath(ckpt)
+        if not os.path.exists(os.path.join(path, "meta.json")):
+            latest = latest_checkpoint(path)
+            if latest is None:
+                sys.exit(f"no draft checkpoint found under {path!r}")
+            path = latest
+        template = jax.eval_shape(lambda: gpt2.init_params(draft_config))
+        one_device = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        shardings = jax.tree_util.tree_map(lambda _: one_device, template)
+        draft_params, meta = restore_params(path, template, shardings)
+        print(f"draft checkpoint: {path} (step {meta.step})",
+              file=sys.stderr)
+    else:
+        draft_params = gpt2.init_params(draft_config)
+    return draft_config, draft_params
+
+
 def build_serve_config(args: argparse.Namespace, config):
     """ServeConfig from the shared engine flags (0 blocks = worst case)."""
     from gpt_2_distributed_tpu.config import ServeConfig
@@ -328,12 +384,16 @@ def build_serve_config(args: argparse.Namespace, config):
 
             data, _ = parse_serve_mesh(mesh)
             num_blocks = -(-num_blocks // data) * data
+    draft = getattr(args, "draft_preset", None)
+    spec = f"draft:{draft},k:{getattr(args, 'spec_k', None) or 4}" \
+        if draft else ""
     return ServeConfig(
         max_batch=args.max_batch, block_size=args.block_size,
         num_blocks=num_blocks, attn_impl=args.attn_impl, eos_id=args.eos,
         prefill_chunk=args.prefill_chunk, prefix_cache=args.prefix_cache,
         admission=args.admission, watermark_blocks=args.watermark_blocks,
         mesh=mesh, prefill_batch=getattr(args, "prefill_batch", 1),
+        spec=spec,
     )
 
 
@@ -437,10 +497,14 @@ def main(argv: list[str] | None = None) -> None:
     else:
         from gpt_2_distributed_tpu.serving import ServingEngine
 
+        draft_config, draft_params = load_draft_model(args, config)
+
         def make_engine():
             return ServingEngine(params, config, serve,
                                  temperature=args.temperature,
-                                 top_k=args.top_k)
+                                 top_k=args.top_k,
+                                 draft_params=draft_params,
+                                 draft_config=draft_config)
     router = ReplicaRouter(make_engine, replicas=1)
     if args.placement in ("subprocess", "remote"):
         make_engine.router = router  # respawn-vs-scale-up attribution

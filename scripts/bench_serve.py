@@ -63,6 +63,21 @@ engines' token streams are bit-identical. The CPU win comes from batching
 fixed per-op overhead; on TPU the same structure amortizes weight reads
 across rows, which is the real prize.
 
+``--spec`` runs the speculative-decoding A/B instead: the same seeded
+closed trace through one engine configuration with speculation off and
+on (``ServeConfig.spec``), asserting the greedy token streams
+bit-identical — speculation must be invisible in tokens — and merging a
+``spec`` record (ITL p50/p99 and tok/s for both runs, acceptance rate,
+tokens per verify pass) into ``--json``. ``--draft_preset`` drafts with
+a real (randomly initialized) preset; without it the draft is the
+SELF-SLICE: the target's upper blocks get their output projections
+zeroed — exact bitwise identities — and the draft is the first
+``--spec_draft_layers`` of the stacked block params, so it computes the
+target function exactly (acceptance 1.0, the mechanism's upper bound)
+while the target still pays full depth per verify. Exits nonzero on
+divergence; any re-emitted or dropped token fails the replay's
+token-count assertion.
+
 ``--placement subprocess --chaos`` is the process-isolation proof: the
 same seeded trace through per-device worker PROCESSES, with replica 0
 killed by ``--chaos_kill {exception,sigkill,sigstop}`` mid-decode — real
@@ -172,6 +187,26 @@ def build_argparser() -> argparse.ArgumentParser:
                    "streams bit-identical, and merge a 'sharded' record "
                    "into --json. Re-execs itself with forced virtual host "
                    "devices when too few are visible")
+    p.add_argument("--spec", action="store_true",
+                   help="speculative-decoding A/B: replay the closed trace "
+                   "with speculation off and on, assert the greedy streams "
+                   "bit-identical, and merge a 'spec' record into --json. "
+                   "Drafts with --draft_preset when given, else with the "
+                   "self-slice draft (see --spec_draft_layers)")
+    p.add_argument("--draft_preset", default=None,
+                   help="draft model preset for --spec (vocab/positions "
+                   "inherited from the target; randomly initialized here, "
+                   "so expect near-zero acceptance — machinery-honest, "
+                   "not a speedup demo)")
+    p.add_argument("--spec_k", type=int, default=None,
+                   help="draft tokens per verify pass (default 4)")
+    p.add_argument("--spec_draft_layers", type=int, default=None,
+                   help="self-slice draft depth for --spec without "
+                   "--draft_preset: the target's blocks past this depth "
+                   "get their output projections zeroed (exact identities) "
+                   "and the draft is the first N stacked blocks, computing "
+                   "the target function exactly (default n_layer//4, "
+                   "min 1)")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top_k", type=int, default=None)
     p.add_argument("--repeats", type=int, default=3,
@@ -326,6 +361,35 @@ def validate_args(p: argparse.ArgumentParser, args: argparse.Namespace) -> None:
         if args.duration > 0 or args.chaos or args.baseline_only:
             p.error("--serve_mesh runs the closed-trace sharded "
                     "comparison; drop --duration/--chaos/--baseline_only")
+    # Speculative-decoding A/B (jax-free: the draft-flag family is
+    # validated by config.validate_worker_flags below; these are the
+    # bench-mode combos).
+    if args.spec:
+        if args.serve_mesh or args.duration > 0 or args.chaos \
+                or args.baseline_only:
+            p.error("--spec runs the closed-trace speculation A/B; drop "
+                    "--serve_mesh/--duration/--chaos/--baseline_only")
+        if args.temperature != 0.0:
+            p.error("--spec asserts greedy bit-equality, so --temperature "
+                    "must be 0 (sampled-speculation exactness is covered "
+                    "by the engine's distribution tests)")
+    if args.spec_draft_layers is not None:
+        if not args.spec or args.draft_preset:
+            p.error("--spec_draft_layers shapes the self-slice draft: it "
+                    "needs --spec and contradicts --draft_preset")
+        if args.spec_draft_layers < 1:
+            p.error(f"--spec_draft_layers {args.spec_draft_layers}: "
+                    "must be >= 1")
+        from gpt_2_distributed_tpu.config import MODEL_PRESETS
+
+        tgt_layers = args.n_layer if args.n_layer is not None else (
+            MODEL_PRESETS[args.model].n_layer
+            if args.model in MODEL_PRESETS else None
+        )
+        if tgt_layers is not None and args.spec_draft_layers >= tgt_layers:
+            p.error(f"--spec_draft_layers {args.spec_draft_layers}: the "
+                    f"self-slice draft must be shallower than the "
+                    f"{tgt_layers}-layer target")
     if args.duration < 0:
         p.error(f"--duration {args.duration}: must be >= 0")
     if args.ramp is not None:
@@ -610,6 +674,25 @@ def run_engine(args, params, config, serve, trace, jax, np, make_engine):
                 (emitted - len(handles)) / steps, 2
             ),
         }
+        if serve.spec:
+            # Per-slot speculation rounds: drafted accumulates k per
+            # active slot per round, so rounds = drafted/k, and each
+            # round emits its accepted run + one verify-sourced token.
+            k = serve.spec_k
+            drafted = eng.stats["spec_draft_tokens"]
+            accepted = eng.stats["spec_accepted_tokens"]
+            rounds = drafted // max(k, 1)
+            rec["spec"] = {
+                "k": k,
+                "draft_tokens": drafted,
+                "accepted_tokens": accepted,
+                "acceptance_rate": round(accepted / max(drafted, 1), 4),
+                "rollbacks": eng.stats["spec_rollbacks"],
+                "tokens_per_verify": round(
+                    (accepted + rounds) / max(rounds, 1), 2),
+                "draft_ms": round(eng.stats["draft_ms"], 1),
+                "verify_ms": round(eng.stats["verify_ms"], 1),
+            }
         return rec, [list(h.generated) for h in handles]
 
     # Best-of-N replays: the streams are deterministic (asserted), only the
@@ -694,6 +777,125 @@ def run_sharded(args, params, config, jax, np, make_engine):
             sharded_rec["tok_s"] / single_rec["tok_s"], 2),
         "streams_bit_identical": sharded_streams == single_streams,
     }
+
+
+def run_spec(args, params, config, jax, np):
+    """Speculative-decoding A/B: the same seeded closed trace through ONE
+    engine configuration with speculation off and on. Greedy speculation
+    is exact — every emitted token is a verify-pass argmax, rejected
+    drafts roll back invisibly — so the two runs must stream every
+    request bit-identically; the record carries ITL/throughput for both
+    plus the acceptance telemetry any improvement is explained by.
+
+    The draft model: ``--draft_preset`` when given (randomly initialized
+    — exercises the honest two-model path, near-zero acceptance), else
+    the SELF-SLICE: the target's blocks past ``--spec_draft_layers`` get
+    ``attn_proj``/``mlp_proj`` weights and biases zeroed, turning them
+    into exact bitwise identities (the residual adds 0), and the draft
+    is the first N stacked blocks sharing wte/wpe/ln_f. The sliced draft
+    then computes the target function EXACTLY — greedy acceptance is 1.0
+    by construction — while the target still pays its full depth per
+    verify dispatch, so the measured ITL win is honest wall-clock, just
+    at the mechanism's acceptance upper bound."""
+    from gpt_2_distributed_tpu.config import MODEL_PRESETS, ServeConfig
+    from gpt_2_distributed_tpu.models import gpt2
+    from gpt_2_distributed_tpu.serving import ServingEngine
+
+    k = args.spec_k or 4
+    if args.draft_preset:
+        draft_config = MODEL_PRESETS[args.draft_preset].replace(
+            vocab_size=config.vocab_size, n_positions=config.n_positions
+        )
+        draft_params = gpt2.init_params(draft_config)
+        draft_rec = {"preset": args.draft_preset, "self_sliced": False,
+                     "n_layer": draft_config.n_layer}
+        spec = f"draft:{args.draft_preset},k:{k}"
+    else:
+        ld = args.spec_draft_layers or max(1, config.n_layer // 4)
+        zero_out = {"attn_proj_w", "attn_proj_b", "mlp_proj_w",
+                    "mlp_proj_b"}
+        params = dict(params)
+        params["block"] = {
+            name: (leaf.at[ld:].set(0) if name in zero_out else leaf)
+            for name, leaf in params["block"].items()
+        }
+        draft_params = dict(params)
+        draft_params["block"] = {
+            name: leaf[:ld] for name, leaf in params["block"].items()
+        }
+        draft_config = config.replace(n_layer=ld)
+        draft_rec = {"preset": None, "self_sliced": True, "n_layer": ld}
+        # The spec string's preset field names what a CLI would load; the
+        # bench hands the engine explicit draft params, so reuse the
+        # target's preset name to keep the string parseable.
+        spec = f"draft:{args.model},k:{k}"
+
+    probe = ServeConfig(max_batch=args.max_batch,
+                        block_size=args.block_size)
+    base = dict(
+        max_batch=args.max_batch, block_size=args.block_size,
+        num_blocks=args.num_blocks or (
+            1 + args.max_batch * probe.max_blocks_per_seq(config.n_positions)
+        ),
+        attn_impl=args.attn_impl, prefill_chunk=args.prefill_chunk,
+        prefix_cache=args.prefix_cache == "on", admission=args.admission,
+        watermark_blocks=args.watermark_blocks,
+        prefill_batch=args.prefill_batch,
+    )
+    serve_off = ServeConfig(**base)
+    serve_on = ServeConfig(**base, spec=spec)
+
+    def make_off(serve):
+        return ServingEngine(params, config, serve,
+                             temperature=args.temperature, top_k=args.top_k)
+
+    def make_on(serve):
+        return ServingEngine(params, config, serve,
+                             temperature=args.temperature, top_k=args.top_k,
+                             draft_params=draft_params,
+                             draft_config=draft_config)
+
+    rec = {
+        "k": k, "draft": draft_rec,
+        "serve": {"max_batch": serve_on.max_batch,
+                  "block_size": serve_on.block_size,
+                  "num_blocks": serve_on.num_blocks,
+                  "prefill_chunk": serve_on.prefill_chunk,
+                  "prefix_cache": serve_on.prefix_cache,
+                  "admission": serve_on.admission},
+        "traces": {},
+    }
+    names = (["original", "shared_prefix"] if args.traces == "both"
+             else [args.traces])
+    for name in names:
+        trace = make_trace(args, np, config.vocab_size,
+                           shared=name == "shared_prefix")
+        off_rec, off_streams = run_engine(
+            args, params, config, serve_off, trace, jax, np, make_off
+        )
+        on_rec, on_streams = run_engine(
+            args, params, config, serve_on, trace, jax, np, make_on
+        )
+        sec = {
+            "trace": trace[3],
+            "off": off_rec,
+            "on": on_rec,
+            "streams_bit_identical": on_streams == off_streams,
+            "acceptance_rate": on_rec["spec"]["acceptance_rate"],
+            "tokens_per_verify": on_rec["spec"]["tokens_per_verify"],
+            "tok_s_ratio": round(on_rec["tok_s"] / off_rec["tok_s"], 2),
+        }
+        if (off_rec["itl_p50_ms"] is not None
+                and on_rec["itl_p50_ms"] is not None):
+            # >1 means speculation tightened the median inter-token gap.
+            # An accepted run emits as a burst, so the on-side median gap
+            # can be ~0; floor the denominator at 10us to keep the ratio
+            # finite rather than dropping the field.
+            sec["itl_p50_improvement"] = round(
+                off_rec["itl_p50_ms"] / max(on_rec["itl_p50_ms"], 0.01), 2
+            )
+        rec["traces"][name] = sec
+    return rec
 
 
 def run_frontend(args, config, serve, jax, np, make_engine, policy,
@@ -1651,6 +1853,30 @@ def main(argv=None) -> None:
             sys.exit("sharded: token streams diverged between the single-"
                      "device and mesh-sharded engines — sharding broke "
                      "bit-exactness")
+        return
+
+    if args.spec:
+        rec = run_spec(args, params, config, jax, np)
+        _XLA_CAPTURE.stop_if_active()
+        get_tracer().close()
+        if args.json:
+            out = {"bench": "serve",
+                   "device": jax.devices()[0].device_kind,
+                   "n_devices": jax.device_count(),
+                   "model": {"preset": args.model, **overrides}}
+            if os.path.exists(args.json):
+                with open(args.json) as f:
+                    out = json.load(f)
+            out["spec"] = rec
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print(json.dumps({"spec": rec}))
+        for name, sec in rec["traces"].items():
+            if not sec["streams_bit_identical"]:
+                sys.exit(f"spec[{name}]: token streams diverged between "
+                         "the speculative and plain engines — greedy "
+                         "speculation must be exact")
         return
 
     if args.chaos and (args.fail_spec is None and args.hang_spec is None

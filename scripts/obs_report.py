@@ -161,6 +161,7 @@ _REQUEST_EVENTS = (
     "cow",
     "prefill_chunk",
     "first_token",
+    "spec_accept",
     "preempt",
     "migrate",
     "resume",
@@ -344,6 +345,34 @@ def worker_lifecycle(records: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def speculation_summary(records: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Two-model engine acceptance: the engine emits one ``spec_accept``
+    event (rid, drafted=k, accepted) per active row per speculative
+    round, and the draft/verify spans already fold into the engine-step
+    breakdown. The measured acceptance rate α here is what the expected
+    speedup model E[tokens/verify] = (1 − α^(k+1)) / (1 − α) plugs in
+    (PERF_ANALYSIS §21). None when the trace never speculated."""
+    evs = [r["attrs"] for r in records
+           if r.get("ph") == "event" and r.get("name") == "spec_accept"]
+    if not evs:
+        return None
+    drafted = sum(int(a.get("drafted", 0)) for a in evs)
+    runs = [int(a.get("accepted", 0)) for a in evs]
+    accepted = sum(runs)
+    return {
+        "n_rounds": len(evs),
+        "n_requests": len({a.get("rid") for a in evs}),
+        "draft_tokens": drafted,
+        "accepted_tokens": accepted,
+        "acceptance_rate": round(accepted / drafted, 4) if drafted else 0.0,
+        "mean_accepted_run": round(accepted / len(runs), 3),
+        # every round also emits one token straight from the verify pass
+        # (the correction or the bonus), so this is the measured
+        # E[tokens/verify].
+        "tokens_per_verify": round(1 + accepted / len(runs), 3),
+    }
+
+
 def build_report(trace_dir: str) -> dict[str, Any]:
     records = load_trace_dir(trace_dir)
     serving = request_waterfall(records)
@@ -353,6 +382,7 @@ def build_report(trace_dir: str) -> dict[str, Any]:
         "train_steps": step_breakdown(records, "step"),
         "engine_steps": step_breakdown(records, "engine_step"),
         "serving": serving,
+        "speculation": speculation_summary(records),
         "frontend": frontend_summary(serving),
         "meshes": mesh_summary(records),
         "workers": worker_lifecycle(records),
@@ -376,12 +406,23 @@ def _print_breakdown(b: dict[str, Any], title: str) -> None:
     print(f"  attributed: {b['attributed_pct']:.1f}% of step wall time")
 
 
-def _print_serving(s: dict[str, Any], limit: int) -> None:
+def _spec_line(sp: dict[str, Any]) -> str:
+    return (f"  speculation: {sp['n_rounds']} round(s) over "
+            f"{sp['n_requests']} request(s), acceptance rate "
+            f"{sp['acceptance_rate']:.0%}, mean accepted run "
+            f"{sp['mean_accepted_run']:.2f}, "
+            f"{sp['tokens_per_verify']:.2f} tokens/verify")
+
+
+def _print_serving(s: dict[str, Any], limit: int,
+                   speculation: dict[str, Any] | None = None) -> None:
     print(f"\n== serving: {s['n_requests']} requests ==")
     if s["ttft"]:
         t = s["ttft"]
         print(f"  TTFT: mean {t['mean_ms']:.2f} ms, p50 {t['p50_ms']:.2f}, "
               f"p99 {t['p99_ms']:.2f}  (n={t['n']})")
+    if speculation:
+        print(_spec_line(speculation))
     print(f"  {'rid':<14} {'admit_ms':>9} {'ttft_ms':>9} {'finish_ms':>10} "
           f"{'chunks':>6} {'preempt':>7} {'cached':>6}")
     for row in s["requests"][:limit]:
@@ -422,6 +463,8 @@ def _print_frontend(report: dict[str, Any], limit: int) -> None:
     if fs["n_migrated"] or fs["n_timed_out"] or fs["n_failed"]:
         print(f"  fault tolerance: {fs['n_migrated']} migrated, "
               f"{fs['n_timed_out']} timed out, {fs['n_failed']} failed")
+    if report.get("speculation"):
+        print(_spec_line(report["speculation"]))
     workers = report.get("workers")
     if workers:
         hosts = ""
@@ -520,7 +563,8 @@ def main(argv: list[str] | None = None) -> int:
     if report["engine_steps"]:
         _print_breakdown(report["engine_steps"], "serving engine-step breakdown")
     if report["serving"]:
-        _print_serving(report["serving"], args.limit)
+        _print_serving(report["serving"], args.limit,
+                       speculation=report.get("speculation"))
     if args.frontend:
         if report["frontend"]:
             _print_frontend(report, args.limit)

@@ -196,6 +196,13 @@ def test_update_call_sites_found():
     assert "prefill_batched" in names
     # PR 18 process isolation: replacement-worker counter (router snapshot)
     assert "worker_restarts" in names
+    # PR 20 speculative decoding: present in BOTH snapshot dict literals
+    # (engine per-replica, router fleet aggregate)
+    assert "spec_draft_tokens" in names
+    assert "spec_accepted_tokens" in names
+    assert "spec_rollbacks" in names
+    assert "draft_ms" in names
+    assert "verify_ms" in names
 
 
 def test_every_pushed_metric_is_registered():
